@@ -1,0 +1,13 @@
+"""photon_ml_tpu — a TPU-native framework with the capabilities of Photon ML.
+
+A ground-up JAX/XLA/Pallas re-design (NOT a port) of the reference
+hubayirp/photon-ml (a fork of linkedin/photon-ml): GLMs (logistic, linear,
+Poisson, smoothed-hinge SVM) and GAME generalized additive mixed-effect
+models, trained by L-BFGS / OWL-QN / TRON, scaled by data parallelism
+(shard_map + psum over ICI) and entity sharding (vmapped per-entity solves)
+instead of Spark RDDs, broadcast, and treeAggregate.
+
+See SURVEY.md at the repo root for the layer map this package mirrors.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,200 @@
+"""Static-shape example batches: the TPU-native replacement for RDD[LabeledPoint].
+
+Reference counterpart: ``LabeledPoint`` / per-partition ``Iterable[LabeledPoint]``
+(photon-api ``com.linkedin.photon.ml.data`` [expected path, mount unavailable —
+see SURVEY.md]).  The reference streams sparse Breeze vectors through a Scala
+fold; on TPU we instead materialize a whole (shard of a) dataset as one
+static-shape array bundle resident in HBM, so every optimizer iteration is a
+handful of fused XLA ops with zero host involvement.
+
+Two layouts:
+
+- ``DenseBatch`` — ``x: [n, d]`` dense features.  Best when d is small
+  (a1a: d=124) — margins are one MXU matmul.
+- ``SparseBatch`` — padded ELL layout: ``values/col_ids: [n, k]`` where k is
+  the per-row nnz capacity (max nnz, possibly bucketed).  ELL keeps shapes
+  static (XLA requirement) while storing only k·n entries of a d-wide matrix;
+  margins are a gather + row-sum, gradients a segment-sum scatter.  This is
+  the TPU answer to Breeze's SparseVector: no CSR row_ptr indirection, which
+  would force dynamic slicing inside jit.
+
+Both carry per-example ``labels, weights, offsets`` (offsets implement GAME
+coordinate-descent residual passing, reference ``GameDatum.offset``) and a
+validity ``mask`` so padding rows contribute zero loss/gradient.
+
+All fields are pytree leaves → batches can be donated, sharded with
+``jax.sharding``, and closed over by jit.  ``dim`` is static metadata.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+Array = jax.Array
+
+
+@struct.dataclass
+class DenseBatch:
+    """Dense feature batch; ``x[i]`` is example i's feature vector."""
+
+    x: Array          # [n, d] float
+    labels: Array     # [n] float
+    weights: Array    # [n] float
+    offsets: Array    # [n] float
+    mask: Array       # [n] float, 1.0 = real example, 0.0 = padding
+
+    @property
+    def dim(self) -> int:
+        return self.x.shape[-1]
+
+    @property
+    def n_padded(self) -> int:
+        return self.x.shape[-2]
+
+    def margins(self, w: Array) -> Array:
+        """x·w + offset, the GLM margin (one MXU matmul)."""
+        return self.x @ w + self.offsets
+
+    def xt_dot(self, r: Array) -> Array:
+        """X^T r — gradient-side contraction (masking folded into r)."""
+        return self.x.T @ r
+
+    def x_dot(self, v: Array) -> Array:
+        """X v — HVP-side contraction."""
+        return self.x @ v
+
+
+@struct.dataclass
+class SparseBatch:
+    """Padded-ELL sparse batch.
+
+    ``col_ids`` padding entries point at column 0 with ``values`` 0.0 so
+    gathers stay in-bounds and scatters add zero; correctness never depends
+    on the padding target.
+    """
+
+    values: Array     # [n, k] float
+    col_ids: Array    # [n, k] int32
+    labels: Array     # [n] float
+    weights: Array    # [n] float
+    offsets: Array    # [n] float
+    mask: Array       # [n] float
+    dim: int = struct.field(pytree_node=False)
+
+    @property
+    def n_padded(self) -> int:
+        return self.values.shape[-2]
+
+    def margins(self, w: Array) -> Array:
+        """Σ_k values[i,k]·w[col_ids[i,k]] + offset — gather + row reduce."""
+        return jnp.sum(self.values * w[self.col_ids], axis=-1) + self.offsets
+
+    def xt_dot(self, r: Array) -> Array:
+        """X^T r via segment-sum scatter-add into the [dim] gradient."""
+        contrib = self.values * r[:, None]            # [n, k]
+        return jax.ops.segment_sum(
+            contrib.reshape(-1),
+            self.col_ids.reshape(-1),
+            num_segments=self.dim,
+        )
+
+    def x_dot(self, v: Array) -> Array:
+        return jnp.sum(self.values * v[self.col_ids], axis=-1)
+
+    def to_dense(self) -> DenseBatch:
+        """Densify (testing / small-dim fast path)."""
+        n, k = self.values.shape
+        x = jnp.zeros((n, self.dim), self.values.dtype)
+        rows = jnp.repeat(jnp.arange(n), k)
+        x = x.at[rows, self.col_ids.reshape(-1)].add(self.values.reshape(-1))
+        return DenseBatch(
+            x=x, labels=self.labels, weights=self.weights,
+            offsets=self.offsets, mask=self.mask,
+        )
+
+
+Batch = Union[DenseBatch, SparseBatch]
+
+
+def make_dense_batch(
+    x: np.ndarray,
+    labels: np.ndarray,
+    weights: np.ndarray | None = None,
+    offsets: np.ndarray | None = None,
+    pad_to: int | None = None,
+    dtype=jnp.float32,
+) -> DenseBatch:
+    """Build a DenseBatch from host arrays, padding rows to ``pad_to``."""
+    n, _ = x.shape
+    weights = np.ones(n) if weights is None else weights
+    offsets = np.zeros(n) if offsets is None else offsets
+    mask = np.ones(n)
+    if pad_to is not None and pad_to > n:
+        pad = pad_to - n
+        x = np.pad(x, ((0, pad), (0, 0)))
+        labels = np.pad(labels, (0, pad))
+        weights = np.pad(weights, (0, pad))
+        offsets = np.pad(offsets, (0, pad))
+        mask = np.pad(mask, (0, pad))
+    return DenseBatch(
+        x=jnp.asarray(x, dtype),
+        labels=jnp.asarray(labels, dtype),
+        weights=jnp.asarray(weights, dtype),
+        offsets=jnp.asarray(offsets, dtype),
+        mask=jnp.asarray(mask, dtype),
+    )
+
+
+def make_sparse_batch(
+    rows: list[tuple[np.ndarray, np.ndarray]],
+    dim: int,
+    labels: np.ndarray,
+    weights: np.ndarray | None = None,
+    offsets: np.ndarray | None = None,
+    row_capacity: int | None = None,
+    pad_to: int | None = None,
+    dtype=jnp.float32,
+) -> SparseBatch:
+    """Build a padded-ELL SparseBatch.
+
+    Args:
+      rows: per-example ``(col_ids, values)`` numpy pairs.
+      dim: feature-space width (static).
+      row_capacity: per-row nnz capacity; defaults to the max observed.
+      pad_to: pad the example count to this (e.g. a multiple of shard count).
+    """
+    n = len(rows)
+    k = row_capacity or max((len(c) for c, _ in rows), default=1)
+    k = max(k, 1)
+    n_out = max(pad_to or n, n)
+    vals = np.zeros((n_out, k), np.float32)
+    cols = np.zeros((n_out, k), np.int32)
+    for i, (c, v) in enumerate(rows):
+        if len(c) > k:
+            raise ValueError(f"row {i} nnz {len(c)} exceeds capacity {k}")
+        vals[i, : len(c)] = v
+        cols[i, : len(c)] = c
+    weights = np.ones(n) if weights is None else np.asarray(weights)
+    offsets = np.zeros(n) if offsets is None else np.asarray(offsets)
+    lab = np.zeros(n_out)
+    lab[:n] = labels
+    wt = np.zeros(n_out)
+    wt[:n] = weights
+    off = np.zeros(n_out)
+    off[:n] = offsets
+    mask = np.zeros(n_out)
+    mask[:n] = 1.0
+    return SparseBatch(
+        values=jnp.asarray(vals, dtype),
+        col_ids=jnp.asarray(cols),
+        labels=jnp.asarray(lab, dtype),
+        weights=jnp.asarray(wt, dtype),
+        offsets=jnp.asarray(off, dtype),
+        mask=jnp.asarray(mask, dtype),
+        dim=dim,
+    )

@@ -1,0 +1,200 @@
+"""Pointwise GLM loss functions.
+
+TPU-native re-design of the reference's pointwise loss hierarchy
+(reference: photon-lib ``com.linkedin.photon.ml.function.glm`` —
+``PointwiseLossFunction``, ``LogisticLossFunction``, ``SquaredLossFunction``,
+``PoissonLossFunction``, ``SmoothedHingeLossFunction`` [expected paths,
+mount unavailable — see SURVEY.md provenance banner]).
+
+Each loss is a pure, stateless namespace of jittable/vmappable functions of
+the *margin* ``z = x·w + offset`` and the label ``y``:
+
+- ``loss(z, y)``   — per-example loss value
+- ``d1(z, y)``     — ∂loss/∂z   (feeds the gradient:  X^T (w ⊙ d1))
+- ``d2(z, y)``     — ∂²loss/∂z² (feeds the HVP:       X^T (w ⊙ d2 ⊙ Xv))
+- ``mean(z)``      — the GLM mean function linking margin to prediction
+  (sigmoid / identity / exp), used at scoring time.
+
+All math is elementwise on arrays, so XLA fuses it straight into the
+surrounding matmul/segment-sum — there is no per-example Python loop
+anywhere (contrast with the reference's per-example Scala fold inside
+``ValueAndGradientAggregator``).
+
+Numerical notes: the logistic loss uses the log1p(exp(-|z|)) stable form;
+Poisson clamps exp to avoid overflow in float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """A GLM pointwise loss: value + first/second margin-derivatives + link.
+
+    Instances are hashable static pytree-leaves-free dataclasses, so they can
+    be closed over by jitted functions or passed as static args.
+    """
+
+    name: str
+    loss: Callable[[Array, Array], Array]
+    d1: Callable[[Array, Array], Array]
+    d2: Callable[[Array, Array], Array]
+    mean: Callable[[Array], Array]
+    # Convexity flag: every reference loss is convex; kept for validators.
+    convex: bool = True
+
+    def __hash__(self) -> int:  # static-arg friendliness under jit
+        return hash(self.name)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PointwiseLoss) and other.name == self.name
+
+
+# ---------------------------------------------------------------------------
+# Logistic loss.  Labels follow the reference convention y ∈ {0, 1}
+# (photon-ml's binary classification reads 0/1 labels from Avro).
+# loss(z, y) = log(1 + e^z) − y·z   (cross-entropy on the margin)
+# d1 = σ(z) − y ;  d2 = σ(z)(1 − σ(z))
+# ---------------------------------------------------------------------------
+
+def _logistic_loss(z: Array, y: Array) -> Array:
+    # log(1+e^z) = max(z,0) + log1p(exp(-|z|))  (stable for large |z|)
+    return jnp.maximum(z, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(z))) - y * z
+
+
+def _logistic_d1(z: Array, y: Array) -> Array:
+    return jax.nn.sigmoid(z) - y
+
+
+def _logistic_d2(z: Array, y: Array) -> Array:
+    s = jax.nn.sigmoid(z)
+    return s * (1.0 - s)
+
+
+LOGISTIC = PointwiseLoss(
+    name="logistic",
+    loss=_logistic_loss,
+    d1=_logistic_d1,
+    d2=_logistic_d2,
+    mean=jax.nn.sigmoid,
+)
+
+
+# ---------------------------------------------------------------------------
+# Squared loss (linear regression):  loss = ½ (z − y)²
+# ---------------------------------------------------------------------------
+
+def _squared_loss(z: Array, y: Array) -> Array:
+    d = z - y
+    return 0.5 * d * d
+
+
+SQUARED = PointwiseLoss(
+    name="squared",
+    loss=_squared_loss,
+    d1=lambda z, y: z - y,
+    d2=lambda z, y: jnp.ones_like(z),
+    mean=lambda z: z,
+)
+
+
+# ---------------------------------------------------------------------------
+# Poisson loss (negative log-likelihood up to a constant):
+#   loss = e^z − y·z ;  d1 = e^z − y ;  d2 = e^z
+# exp clamped at z=MAX_EXP_ARG to keep float32 finite; beyond that the
+# optimizer is diverging anyway and the clamp keeps gradients pointed back.
+# ---------------------------------------------------------------------------
+
+_MAX_EXP_ARG = 30.0
+
+
+def _poisson_exp(z: Array) -> Array:
+    return jnp.exp(jnp.minimum(z, _MAX_EXP_ARG))
+
+
+POISSON = PointwiseLoss(
+    name="poisson",
+    loss=lambda z, y: _poisson_exp(z) - y * z,
+    d1=lambda z, y: _poisson_exp(z) - y,
+    d2=lambda z, y: _poisson_exp(z),
+    mean=_poisson_exp,
+)
+
+
+# ---------------------------------------------------------------------------
+# Smoothed hinge loss (linear SVM surrogate).  Reference semantics
+# (SmoothedHingeLossFunction): labels y ∈ {0,1} are mapped to s ∈ {−1,+1};
+# with t = s·z:
+#   t ≥ 1      → 0
+#   t ≤ 0      → ½ − t
+#   0 < t < 1  → ½ (1 − t)²
+# Piecewise-smooth; d2 is its almost-everywhere second derivative (the
+# reference likewise feeds TRON a Gauss-Newton-style d2).
+# ---------------------------------------------------------------------------
+
+def _hinge_t(z: Array, y: Array) -> Array:
+    s = 2.0 * y - 1.0
+    return s * z
+
+
+def _smoothed_hinge_loss(z: Array, y: Array) -> Array:
+    t = _hinge_t(z, y)
+    return jnp.where(
+        t >= 1.0,
+        0.0,
+        jnp.where(t <= 0.0, 0.5 - t, 0.5 * (1.0 - t) * (1.0 - t)),
+    )
+
+
+def _smoothed_hinge_d1(z: Array, y: Array) -> Array:
+    s = 2.0 * y - 1.0
+    t = s * z
+    dt = jnp.where(t >= 1.0, 0.0, jnp.where(t <= 0.0, -1.0, t - 1.0))
+    return s * dt
+
+
+def _smoothed_hinge_d2(z: Array, y: Array) -> Array:
+    t = _hinge_t(z, y)
+    return jnp.where((t > 0.0) & (t < 1.0), 1.0, 0.0)
+
+
+SMOOTHED_HINGE = PointwiseLoss(
+    name="smoothed_hinge",
+    loss=_smoothed_hinge_loss,
+    d1=_smoothed_hinge_d1,
+    d2=_smoothed_hinge_d2,
+    # Scores for SVM are raw margins; "mean" is identity (no probabilistic link).
+    mean=lambda z: z,
+)
+
+
+_BY_NAME = {
+    l.name: l for l in (LOGISTIC, SQUARED, POISSON, SMOOTHED_HINGE)
+}
+# Reference task-type aliases (TaskType enum).
+_BY_NAME.update(
+    {
+        "logistic_regression": LOGISTIC,
+        "linear_regression": SQUARED,
+        "poisson_regression": POISSON,
+        "smoothed_hinge_loss_linear_svm": SMOOTHED_HINGE,
+    }
+)
+
+
+def get_loss(name: str) -> PointwiseLoss:
+    """Look up a loss by name or reference TaskType alias."""
+    key = name.lower()
+    if key not in _BY_NAME:
+        raise ValueError(
+            f"Unknown loss '{name}'. Available: {sorted(_BY_NAME)}"
+        )
+    return _BY_NAME[key]

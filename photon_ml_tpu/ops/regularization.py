@@ -1,0 +1,116 @@
+"""Regularization contexts (L1 / L2 / elastic net).
+
+Reference counterpart: ``RegularizationContext`` /
+``ElasticNetRegularizationContext`` / ``RegularizationType``
+(photon-lib ``com.linkedin.photon.ml.optimization`` [expected path, mount
+unavailable — see SURVEY.md]).
+
+Semantics mirror the reference:
+
+- the **L2 part** is smooth and folded directly into the objective's
+  value / gradient / Hessian-vector product (weight ``alpha·λ`` ... for
+  elastic net the split is ``l1 = α·λ``, ``l2 = (1−α)·λ``);
+- the **L1 part** is non-smooth and is NOT part of the differentiable
+  objective — it is handled by the optimizer (OWL-QN's orthant-wise
+  projection), exactly as Breeze's OWLQN does for the reference.
+
+The intercept column can be excluded from regularization via
+``intercept_index`` (the reference excludes the intercept when
+``addIntercept`` is on).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+Array = jax.Array
+
+
+class RegularizationType(str, enum.Enum):
+    NONE = "NONE"
+    L1 = "L1"
+    L2 = "L2"
+    ELASTIC_NET = "ELASTIC_NET"
+
+
+@struct.dataclass
+class RegularizationContext:
+    """Split of the regularization weight into smooth (l2) and l1 parts.
+
+    ``reg_mask`` (optional, [dim]) zeroes regularization on chosen
+    coordinates (used to exempt the intercept).
+    """
+
+    l1_weight: Array  # scalar
+    l2_weight: Array  # scalar
+    reg_mask: Array | None = None  # [dim] or None (regularize everything)
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def none() -> "RegularizationContext":
+        return RegularizationContext(
+            l1_weight=jnp.asarray(0.0), l2_weight=jnp.asarray(0.0)
+        )
+
+    @staticmethod
+    def l2(weight: float, reg_mask: Array | None = None) -> "RegularizationContext":
+        return RegularizationContext(
+            l1_weight=jnp.asarray(0.0),
+            l2_weight=jnp.asarray(weight, jnp.float32),
+            reg_mask=reg_mask,
+        )
+
+    @staticmethod
+    def l1(weight: float, reg_mask: Array | None = None) -> "RegularizationContext":
+        return RegularizationContext(
+            l1_weight=jnp.asarray(weight, jnp.float32),
+            l2_weight=jnp.asarray(0.0),
+            reg_mask=reg_mask,
+        )
+
+    @staticmethod
+    def elastic_net(
+        weight: float, alpha: float, reg_mask: Array | None = None
+    ) -> "RegularizationContext":
+        """Reference convention: l1 = α·λ, l2 = (1−α)·λ."""
+        return RegularizationContext(
+            l1_weight=jnp.asarray(alpha * weight, jnp.float32),
+            l2_weight=jnp.asarray((1.0 - alpha) * weight, jnp.float32),
+            reg_mask=reg_mask,
+        )
+
+    # -- smooth (L2) part ---------------------------------------------------
+
+    def _masked(self, w: Array) -> Array:
+        return w if self.reg_mask is None else w * self.reg_mask
+
+    def l2_value(self, w: Array) -> Array:
+        wm = self._masked(w)
+        return 0.5 * self.l2_weight * jnp.vdot(wm, wm)
+
+    def l2_gradient(self, w: Array) -> Array:
+        return self.l2_weight * self._masked(w)
+
+    def l2_hessian_vector(self, v: Array) -> Array:
+        return self.l2_weight * self._masked(v)
+
+    def l2_hessian_diagonal(self, w: Array) -> Array:
+        ones = jnp.ones_like(w)
+        return self.l2_weight * self._masked(ones)
+
+    # -- non-smooth (L1) part — optimizer-facing ----------------------------
+
+    def l1_value(self, w: Array) -> Array:
+        return self.l1_weight * jnp.sum(jnp.abs(self._masked(w)))
+
+
+def exclude_intercept_mask(dim: int, intercept_index: int | None) -> Array | None:
+    """[dim] mask that exempts the intercept coordinate, or None."""
+    if intercept_index is None:
+        return None
+    return jnp.ones((dim,), jnp.float32).at[intercept_index].set(0.0)

@@ -1,0 +1,33 @@
+"""Test substrate: single-process simulated 8-device mesh.
+
+TPU analog of the reference's "Spark local[*] mode is the fake cluster"
+strategy (SURVEY.md §4): force 8 virtual CPU devices so shard_map/pjit
+tests exercise the real collective code paths without hardware.  Must run
+before jax initializes its backends, hence env mutation at conftest import.
+"""
+
+import os
+
+# The axon TPU plugin in this image pins JAX_PLATFORMS=axon and ignores env
+# overrides; dropping the var and using config.update is what actually works.
+os.environ.pop("JAX_PLATFORMS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# x64 available for finite-difference reference math; production arrays are
+# created float32 explicitly, so float32 code paths are still what's tested.
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

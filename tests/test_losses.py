@@ -1,0 +1,90 @@
+"""Unit tests for pointwise losses: derivatives via finite differences.
+
+Mirrors the reference's unit-test strategy for the glm loss hierarchy
+(finite-difference checks against closed forms, SURVEY.md §4 tier 1).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops import losses
+
+
+ALL = [losses.LOGISTIC, losses.SQUARED, losses.POISSON, losses.SMOOTHED_HINGE]
+LABELS = {
+    "logistic": [0.0, 1.0],
+    "squared": [-2.3, 0.0, 1.7],
+    "poisson": [0.0, 1.0, 3.0],
+    "smoothed_hinge": [0.0, 1.0],
+}
+# Margins avoiding the hinge's kink points {0, 1} where FD is invalid.
+MARGINS = [-3.1, -0.52, 0.37, 1.44, 2.9]
+
+
+@pytest.mark.parametrize("loss", ALL, ids=lambda l: l.name)
+def test_d1_matches_finite_difference(loss):
+    eps = 1e-4
+    for y in LABELS[loss.name]:
+        for z in MARGINS:
+            z, y = jnp.float64(z), jnp.float64(y)
+            fd = (loss.loss(z + eps, y) - loss.loss(z - eps, y)) / (2 * eps)
+            np.testing.assert_allclose(loss.d1(z, y), fd, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("loss", ALL, ids=lambda l: l.name)
+def test_d2_matches_finite_difference_of_d1(loss):
+    eps = 1e-4
+    for y in LABELS[loss.name]:
+        for z in MARGINS:
+            z, y = jnp.float64(z), jnp.float64(y)
+            fd = (loss.d1(z + eps, y) - loss.d1(z - eps, y)) / (2 * eps)
+            np.testing.assert_allclose(loss.d2(z, y), fd, rtol=1e-4, atol=1e-6)
+
+
+def test_logistic_known_values():
+    # loss(0, y) = log 2 for either label; d1(0, 1) = -0.5.
+    np.testing.assert_allclose(
+        losses.LOGISTIC.loss(jnp.float32(0.0), jnp.float32(1.0)),
+        np.log(2.0), rtol=1e-6)
+    np.testing.assert_allclose(
+        losses.LOGISTIC.d1(jnp.float32(0.0), jnp.float32(1.0)), -0.5, rtol=1e-6)
+
+
+def test_logistic_stable_at_extreme_margins():
+    for z in [-80.0, 80.0]:
+        v = losses.LOGISTIC.loss(jnp.float32(z), jnp.float32(1.0))
+        assert np.isfinite(v)
+        g = losses.LOGISTIC.d1(jnp.float32(z), jnp.float32(0.0))
+        assert np.isfinite(g)
+
+
+def test_squared_known_values():
+    np.testing.assert_allclose(
+        losses.SQUARED.loss(jnp.float32(3.0), jnp.float32(1.0)), 2.0)
+
+
+def test_smoothed_hinge_piecewise_values():
+    l, y1 = losses.SMOOTHED_HINGE, jnp.float32(1.0)
+    np.testing.assert_allclose(l.loss(jnp.float32(2.0), y1), 0.0)       # t>=1
+    np.testing.assert_allclose(l.loss(jnp.float32(-1.0), y1), 1.5)      # t<=0
+    np.testing.assert_allclose(l.loss(jnp.float32(0.5), y1), 0.125)     # mid
+    # label 0 mirrors: t = -z
+    y0 = jnp.float32(0.0)
+    np.testing.assert_allclose(l.loss(jnp.float32(-2.0), y0), 0.0)
+
+
+def test_get_loss_aliases():
+    assert losses.get_loss("LOGISTIC_REGRESSION") is losses.LOGISTIC
+    assert losses.get_loss("poisson") is losses.POISSON
+    with pytest.raises(ValueError):
+        losses.get_loss("nope")
+
+
+def test_losses_vmap_and_jit():
+    z = jnp.linspace(-2, 2, 8)
+    y = jnp.ones(8)
+    for l in ALL:
+        out = jax.jit(jax.vmap(l.loss))(z, y)
+        assert out.shape == (8,)

@@ -1,0 +1,211 @@
+"""GLMObjective tests: gradient/HVP vs autodiff and finite differences,
+sparse-vs-dense equivalence, normalization algebra, padding invariance.
+
+Mirrors the reference's aggregator unit tests (SURVEY.md §4 tier 1:
+ValueAndGradientAggregator / HessianVectorAggregator checks).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.batch import make_dense_batch, make_sparse_batch
+from photon_ml_tpu.data.normalization import (
+    NormalizationContext,
+    NormalizationType,
+    compute_normalization,
+)
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.regularization import RegularizationContext
+
+
+def _random_problem(rng, n=40, d=7, sparse=False, k=4):
+    labels = rng.integers(0, 2, n).astype(np.float64)
+    weights = rng.uniform(0.5, 2.0, n)
+    offsets = rng.normal(0, 0.3, n)
+    if sparse:
+        rows = []
+        dense = np.zeros((n, d))
+        for i in range(n):
+            nnz = rng.integers(1, k + 1)
+            cols = rng.choice(d, nnz, replace=False).astype(np.int32)
+            vals = rng.normal(0, 1, nnz)
+            rows.append((cols, vals))
+            dense[i, cols] = vals
+        batch = make_sparse_batch(
+            rows, d, labels, weights, offsets, row_capacity=k
+        )
+        return batch, dense
+    x = rng.normal(0, 1, (n, d))
+    return make_dense_batch(x, labels, weights, offsets), x
+
+
+def _numpy_reference(loss, x, labels, weights, offsets, w, l2):
+    """Straight-line numpy recomputation of value and gradient."""
+    z = x @ w + offsets
+    lv = np.asarray(jax.vmap(loss.loss)(jnp.asarray(z, jnp.float32),
+                                        jnp.asarray(labels, jnp.float32)))
+    val = float(np.sum(weights * lv) + 0.5 * l2 * w @ w)
+    d1 = np.asarray(jax.vmap(loss.d1)(jnp.asarray(z, jnp.float32),
+                                      jnp.asarray(labels, jnp.float32)))
+    grad = x.T @ (weights * d1) + l2 * w
+    return val, grad
+
+
+@pytest.mark.parametrize("loss", [losses.LOGISTIC, losses.SQUARED,
+                                  losses.POISSON, losses.SMOOTHED_HINGE],
+                         ids=lambda l: l.name)
+@pytest.mark.parametrize("sparse", [False, True], ids=["dense", "sparse"])
+def test_value_and_gradient_vs_numpy(rng, loss, sparse):
+    batch, x = _random_problem(rng, sparse=sparse)
+    d = x.shape[1]
+    w = rng.normal(0, 0.4, d)
+    l2 = 0.7
+    obj = GLMObjective(
+        loss=loss,
+        reg=RegularizationContext.l2(l2),
+        norm=NormalizationContext.identity(),
+    )
+    val, grad = obj.value_and_gradient(jnp.asarray(w, jnp.float32), batch)
+    ref_val, ref_grad = _numpy_reference(
+        loss, x, np.asarray(batch.labels)[: x.shape[0]][: len(x)],
+        np.asarray(batch.weights)[: len(x)],
+        np.asarray(batch.offsets)[: len(x)], w, l2)
+    np.testing.assert_allclose(val, ref_val, rtol=1e-4)
+    np.testing.assert_allclose(grad, ref_grad, rtol=1e-3, atol=1e-4)
+
+
+def test_gradient_matches_jax_autodiff(rng):
+    batch, x = _random_problem(rng)
+    d = x.shape[1]
+    w = jnp.asarray(rng.normal(0, 0.5, d), jnp.float32)
+    obj = GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=RegularizationContext.l2(0.3),
+        norm=NormalizationContext.identity(),
+    )
+    g_manual = obj.gradient(w, batch)
+    g_auto = jax.grad(lambda ww: obj.value(ww, batch))(w)
+    np.testing.assert_allclose(g_manual, g_auto, rtol=1e-4, atol=1e-5)
+
+
+def test_hvp_matches_jax_autodiff(rng):
+    batch, x = _random_problem(rng)
+    d = x.shape[1]
+    w = jnp.asarray(rng.normal(0, 0.5, d), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1.0, d), jnp.float32)
+    obj = GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=RegularizationContext.l2(0.3),
+        norm=NormalizationContext.identity(),
+    )
+    hvp_manual = obj.hessian_vector(w, v, batch)
+    hvp_auto = jax.jvp(lambda ww: obj.gradient(ww, batch), (w,), (v,))[1]
+    np.testing.assert_allclose(hvp_manual, hvp_auto, rtol=1e-3, atol=1e-4)
+
+
+def test_hessian_diagonal_matches_full_hessian(rng):
+    batch, x = _random_problem(rng, n=25, d=5)
+    w = jnp.asarray(rng.normal(0, 0.5, 5), jnp.float32)
+    obj = GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=RegularizationContext.l2(0.2),
+        norm=NormalizationContext.identity(),
+    )
+    H = jax.hessian(lambda ww: obj.value(ww, batch))(w)
+    np.testing.assert_allclose(
+        obj.hessian_diagonal(w, batch), jnp.diagonal(H), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_sparse_dense_equivalence(rng):
+    sbatch, dense_x = _random_problem(rng, sparse=True)
+    dbatch = make_dense_batch(
+        dense_x,
+        np.asarray(sbatch.labels), np.asarray(sbatch.weights),
+        np.asarray(sbatch.offsets))
+    w = jnp.asarray(rng.normal(0, 0.5, sbatch.dim), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1.0, sbatch.dim), jnp.float32)
+    obj = GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=RegularizationContext.l2(0.1),
+        norm=NormalizationContext.identity(),
+    )
+    vs, gs = obj.value_and_gradient(w, sbatch)
+    vd, gd = obj.value_and_gradient(w, dbatch)
+    np.testing.assert_allclose(vs, vd, rtol=1e-5)
+    np.testing.assert_allclose(gs, gd, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        obj.hessian_vector(w, v, sbatch), obj.hessian_vector(w, v, dbatch),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_padding_rows_do_not_change_results(rng):
+    x = rng.normal(0, 1, (10, 4))
+    labels = rng.integers(0, 2, 10).astype(float)
+    b1 = make_dense_batch(x, labels)
+    b2 = make_dense_batch(x, labels, pad_to=32)
+    w = jnp.asarray(rng.normal(0, 0.5, 4), jnp.float32)
+    obj = GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=RegularizationContext.none(),
+        norm=NormalizationContext.identity(),
+    )
+    v1, g1 = obj.value_and_gradient(w, b1)
+    v2, g2 = obj.value_and_gradient(w, b2)
+    np.testing.assert_allclose(v1, v2, rtol=1e-5)
+    np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-6)
+
+
+def test_normalization_equals_materialized_transform(rng):
+    """Objective with in-kernel normalization == objective on pre-transformed
+    data — the invariant the reference's NormalizationContext guarantees."""
+    n, d = 30, 6
+    x = rng.normal(2.0, 3.0, (n, d))
+    labels = rng.integers(0, 2, n).astype(float)
+    mean, std = x.mean(0), x.std(0)
+    norm = compute_normalization(
+        jnp.asarray(mean, jnp.float32), jnp.asarray(std, jnp.float32),
+        jnp.asarray(np.abs(x).max(0), jnp.float32),
+        NormalizationType.STANDARDIZATION)
+    raw = make_dense_batch(x, labels)
+    transformed = make_dense_batch((x - mean) / std, labels)
+    w = jnp.asarray(rng.normal(0, 0.5, d), jnp.float32)
+    obj_norm = GLMObjective(
+        loss=losses.LOGISTIC, reg=RegularizationContext.l2(0.4), norm=norm)
+    obj_plain = GLMObjective(
+        loss=losses.LOGISTIC, reg=RegularizationContext.l2(0.4),
+        norm=NormalizationContext.identity())
+    v1, g1 = obj_norm.value_and_gradient(w, raw)
+    v2, g2 = obj_plain.value_and_gradient(w, transformed)
+    np.testing.assert_allclose(v1, v2, rtol=1e-4)
+    np.testing.assert_allclose(g1, g2, rtol=1e-3, atol=1e-4)
+    # HVP under normalization also matches.
+    v = jnp.asarray(rng.normal(0, 1, d), jnp.float32)
+    np.testing.assert_allclose(
+        obj_norm.hessian_vector(w, v, raw),
+        obj_plain.hessian_vector(w, v, transformed), rtol=1e-3, atol=1e-4)
+    # Hessian diagonal with shifts (cross-term path).
+    H = jax.hessian(lambda ww: obj_norm.value(ww, raw))(w)
+    np.testing.assert_allclose(
+        obj_norm.hessian_diagonal(w, raw), jnp.diagonal(H),
+        rtol=1e-3, atol=1e-4)
+
+
+def test_objective_jit_and_vmap(rng):
+    batch, x = _random_problem(rng, n=16, d=5)
+    obj = GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=RegularizationContext.l2(0.1),
+        norm=NormalizationContext.identity(),
+    )
+    f = jax.jit(obj.value_and_gradient)
+    w = jnp.zeros(5)
+    v, g = f(w, batch)
+    assert np.isfinite(v)
+    # vmap over a batch of coefficient vectors (random-effect pattern).
+    ws = jnp.asarray(rng.normal(0, 0.3, (6, 5)), jnp.float32)
+    vals = jax.vmap(lambda ww: obj.value(ww, batch))(ws)
+    assert vals.shape == (6,)
