@@ -177,6 +177,14 @@ def make_sparse_batch(
     for i, (c, v) in enumerate(rows):
         if len(c) > k:
             raise ValueError(f"row {i} nnz {len(c)} exceeds capacity {k}")
+        # Duplicate column ids within a row would silently break
+        # hessian_diagonal (which squares values elementwise, so duplicates
+        # give Σv² instead of (Σv)²); reject them at construction time.
+        if len(np.unique(c)) != len(c):
+            raise ValueError(
+                f"row {i} has duplicate column ids; SparseBatch requires "
+                "unique col_ids per row (pre-sum duplicates on the host)"
+            )
         vals[i, : len(c)] = v
         cols[i, : len(c)] = c
     weights = np.ones(n) if weights is None else np.asarray(weights)

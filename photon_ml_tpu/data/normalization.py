@@ -82,13 +82,6 @@ class NormalizationContext:
             g = g - r_sum * (f * self.shifts)
         return g
 
-    # Variance helper used by the FULL variance computation.
-    def diag_to_model(self, d_raw: Array, d2_sum: Array, cross: Array) -> Array:
-        raise NotImplementedError(
-            "Hessian-diagonal under shift-normalization is computed by the "
-            "objective directly via two HVP-style passes."
-        )
-
 
 def compute_normalization(
     stats_mean: Array,

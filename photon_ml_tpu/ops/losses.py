@@ -108,24 +108,52 @@ SQUARED = PointwiseLoss(
 
 # ---------------------------------------------------------------------------
 # Poisson loss (negative log-likelihood up to a constant):
-#   loss = e^z − y·z ;  d1 = e^z − y ;  d2 = e^z
-# exp clamped at z=MAX_EXP_ARG to keep float32 finite; beyond that the
-# optimizer is diverging anyway and the clamp keeps gradients pointed back.
+#   loss = ẽ(z) − y·z ;  d1 = ẽ'(z) − y ;  d2 = ẽ''(z)
+# where ẽ is exp softened beyond z=MAX_EXP_ARG by a quadratic (Huber-style)
+# extension, so loss/d1/d2 remain exact mutual derivatives everywhere (a
+# plain clamp makes value and gradient inconsistent past the clamp, which
+# can stall Wolfe line searches).  ẽ matches exp in value and first two
+# derivatives at the switch point, stays finite in float32, and keeps
+# curvature positive so trust-region steps pull back toward the optimum.
 # ---------------------------------------------------------------------------
 
 _MAX_EXP_ARG = 30.0
 
 
-def _poisson_exp(z: Array) -> Array:
-    return jnp.exp(jnp.minimum(z, _MAX_EXP_ARG))
+def _soft_exp(z: Array) -> Array:
+    """ẽ(z): exp for z ≤ M, e^M·(1 + t + t²/2), t = z − M, beyond."""
+    t = z - _MAX_EXP_ARG
+    cap = jnp.exp(jnp.asarray(_MAX_EXP_ARG, z.dtype))
+    return jnp.where(
+        z <= _MAX_EXP_ARG,
+        jnp.exp(jnp.minimum(z, _MAX_EXP_ARG)),
+        cap * (1.0 + t + 0.5 * t * t),
+    )
+
+
+def _soft_exp_d1(z: Array) -> Array:
+    t = z - _MAX_EXP_ARG
+    cap = jnp.exp(jnp.asarray(_MAX_EXP_ARG, z.dtype))
+    return jnp.where(
+        z <= _MAX_EXP_ARG,
+        jnp.exp(jnp.minimum(z, _MAX_EXP_ARG)),
+        cap * (1.0 + t),
+    )
+
+
+def _soft_exp_d2(z: Array) -> Array:
+    cap = jnp.exp(jnp.asarray(_MAX_EXP_ARG, z.dtype))
+    return jnp.where(
+        z <= _MAX_EXP_ARG, jnp.exp(jnp.minimum(z, _MAX_EXP_ARG)), cap
+    )
 
 
 POISSON = PointwiseLoss(
     name="poisson",
-    loss=lambda z, y: _poisson_exp(z) - y * z,
-    d1=lambda z, y: _poisson_exp(z) - y,
-    d2=lambda z, y: _poisson_exp(z),
-    mean=_poisson_exp,
+    loss=lambda z, y: _soft_exp(z) - y * z,
+    d1=lambda z, y: _soft_exp_d1(z) - y,
+    d2=lambda z, y: _soft_exp_d2(z),
+    mean=_soft_exp,
 )
 
 

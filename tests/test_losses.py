@@ -88,3 +88,29 @@ def test_losses_vmap_and_jit():
     for l in ALL:
         out = jax.jit(jax.vmap(l.loss))(z, y)
         assert out.shape == (8,)
+
+
+def test_poisson_clamp_is_self_consistent():
+    """Beyond z=30 the softened exp must keep loss/d1/d2 mutual derivatives."""
+    eps = 1e-3
+    y = jnp.float64(2.0)
+    for z in [29.0, 29.999, 30.0, 30.001, 31.0, 45.0, 200.0]:
+        z = jnp.float64(z)
+        fd1 = (losses.POISSON.loss(z + eps, y) - losses.POISSON.loss(z - eps, y)) / (2 * eps)
+        np.testing.assert_allclose(losses.POISSON.d1(z, y), fd1, rtol=1e-5)
+        fd2 = (losses.POISSON.d1(z + eps, y) - losses.POISSON.d1(z - eps, y)) / (2 * eps)
+        # rtol 1e-3: the FD stencil may straddle the z=30 switch point where
+        # the third derivative jumps; the inconsistency this guards against
+        # (plain clamp) is an order-1 error.
+        np.testing.assert_allclose(losses.POISSON.d2(z, y), fd2, rtol=1e-3)
+    # And it stays finite in float32 far beyond the clamp.
+    big = losses.POISSON.loss(jnp.float32(500.0), jnp.float32(1.0))
+    assert np.isfinite(np.asarray(big))
+
+
+def test_sparse_batch_rejects_duplicate_col_ids():
+    from photon_ml_tpu.data.batch import make_sparse_batch
+
+    rows = [(np.array([0, 3, 3]), np.array([1.0, 2.0, 1.0]))]
+    with pytest.raises(ValueError, match="duplicate column ids"):
+        make_sparse_batch(rows, dim=5, labels=np.array([1.0]))

@@ -69,10 +69,11 @@ def test_value_and_gradient_vs_numpy(rng, loss, sparse):
         norm=NormalizationContext.identity(),
     )
     val, grad = obj.value_and_gradient(jnp.asarray(w, jnp.float32), batch)
+    n = x.shape[0]
     ref_val, ref_grad = _numpy_reference(
-        loss, x, np.asarray(batch.labels)[: x.shape[0]][: len(x)],
-        np.asarray(batch.weights)[: len(x)],
-        np.asarray(batch.offsets)[: len(x)], w, l2)
+        loss, x, np.asarray(batch.labels)[:n],
+        np.asarray(batch.weights)[:n],
+        np.asarray(batch.offsets)[:n], w, l2)
     np.testing.assert_allclose(val, ref_val, rtol=1e-4)
     np.testing.assert_allclose(grad, ref_grad, rtol=1e-3, atol=1e-4)
 
