@@ -1,0 +1,27 @@
+"""Optimizers: L-BFGS / OWL-QN / TRON as jittable+vmappable JAX solvers.
+
+Reference: photon-lib ``com.linkedin.photon.ml.optimization`` (SURVEY.md
+§2.1 — expected paths, mount unavailable).
+"""
+
+from photon_ml_tpu.optim.base import (
+    OptimizationResult,
+    OptimizerConfig,
+    OptimizerType,
+    StatesTracker,
+)
+from photon_ml_tpu.optim.lbfgs import lbfgs_solve, owlqn_solve
+from photon_ml_tpu.optim.problem import OptimizationProblem, solve_batched
+from photon_ml_tpu.optim.tron import tron_solve
+
+__all__ = [
+    "OptimizationResult",
+    "OptimizerConfig",
+    "OptimizerType",
+    "StatesTracker",
+    "lbfgs_solve",
+    "owlqn_solve",
+    "tron_solve",
+    "OptimizationProblem",
+    "solve_batched",
+]
