@@ -1,0 +1,106 @@
+"""Per-feature summary statistics over a dataset.
+
+Reference counterpart: ``FeatureDataStatistics`` /
+``BasicStatisticalSummary`` (photon-api
+``com.linkedin.photon.ml.stat`` [expected path, mount unavailable — see
+SURVEY.md]) — computed there by a Spark aggregation; here by a single
+jitted pass of masked reductions over the batch (or a psum-reduced pass
+over shards via the distributed objective's mesh — the stats are plain
+sums, so sharding composes trivially).
+
+These feed ``compute_normalization`` (SURVEY §2.4): mean/std for
+standardization, max|x| for max-magnitude scaling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from photon_ml_tpu.data.batch import Batch, DenseBatch, SparseBatch
+
+Array = jax.Array
+
+
+@struct.dataclass
+class FeatureStatistics:
+    """Per-feature [dim] summaries over the *unweighted* examples
+    (matching the reference, which summarizes raw features)."""
+
+    count: Array      # scalar — number of (real) examples
+    mean: Array       # [dim]
+    variance: Array   # [dim] (population variance, as Spark's Summarizer)
+    std: Array        # [dim]
+    min: Array        # [dim]
+    max: Array        # [dim]
+    max_abs: Array    # [dim]
+    num_nonzeros: Array  # [dim]
+
+    @property
+    def dim(self) -> int:
+        return self.mean.shape[-1]
+
+
+def compute_statistics(batch: Batch) -> FeatureStatistics:
+    """One pass of masked reductions → FeatureStatistics (jittable).
+
+    Sparse batches are summarized without densification: sums and
+    sums-of-squares come from segment-sums over the ELL entries; min/max
+    account for implicit zeros (a feature absent from some rows has
+    min ≤ 0 ≤ max contributions from those rows).
+    """
+    mask = batch.mask
+    n = jnp.sum(mask)
+    dim = batch.dim
+
+    if isinstance(batch, DenseBatch):
+        xm = batch.x * mask[:, None]
+        s1 = jnp.sum(xm, axis=0)
+        s2 = jnp.sum(xm * batch.x, axis=0)
+        nnz = jnp.sum((batch.x != 0.0) & (mask[:, None] > 0.0), axis=0)
+        # Masked rows must not affect min/max: substitute +inf/−inf.
+        big = jnp.inf
+        x_min = jnp.min(jnp.where(mask[:, None] > 0.0, batch.x, big), axis=0)
+        x_max = jnp.max(jnp.where(mask[:, None] > 0.0, batch.x, -big), axis=0)
+    else:
+        assert isinstance(batch, SparseBatch)
+        vm = batch.values * mask[:, None]
+        cols = batch.col_ids.reshape(-1)
+        s1 = jax.ops.segment_sum(vm.reshape(-1), cols, num_segments=dim)
+        s2 = jax.ops.segment_sum(
+            (vm * batch.values).reshape(-1), cols, num_segments=dim
+        )
+        real_entry = ((batch.values != 0.0) & (mask[:, None] > 0.0))
+        nnz = jax.ops.segment_sum(
+            real_entry.astype(jnp.float32).reshape(-1), cols, num_segments=dim
+        )
+        # Explicit-entry extrema; zero-fill features with implicit zeros.
+        big = jnp.asarray(jnp.inf, batch.values.dtype)
+        v_min_entries = jnp.where(real_entry, batch.values, big).reshape(-1)
+        v_max_entries = jnp.where(real_entry, batch.values, -big).reshape(-1)
+        x_min = jax.ops.segment_min(v_min_entries, cols, num_segments=dim)
+        x_max = jax.ops.segment_max(v_max_entries, cols, num_segments=dim)
+        # A feature with fewer explicit entries than examples has implicit
+        # zeros → extrema must include 0.
+        has_implicit_zero = nnz < n
+        x_min = jnp.where(has_implicit_zero, jnp.minimum(x_min, 0.0), x_min)
+        x_max = jnp.where(has_implicit_zero, jnp.maximum(x_max, 0.0), x_max)
+
+    # Unseen features (all-padding columns): clean zeros, not ±inf.
+    x_min = jnp.where(jnp.isfinite(x_min), x_min, 0.0)
+    x_max = jnp.where(jnp.isfinite(x_max), x_max, 0.0)
+
+    n_safe = jnp.maximum(n, 1.0)
+    mean = s1 / n_safe
+    var = jnp.maximum(s2 / n_safe - mean * mean, 0.0)
+    return FeatureStatistics(
+        count=n,
+        mean=mean,
+        variance=var,
+        std=jnp.sqrt(var),
+        min=x_min,
+        max=x_max,
+        max_abs=jnp.maximum(jnp.abs(x_min), jnp.abs(x_max)),
+        num_nonzeros=nnz,
+    )

@@ -1,0 +1,27 @@
+"""Evaluators: AUC/RMSE/loss metrics + sharded per-entity variants.
+
+Reference: photon-api ``com.linkedin.photon.ml.evaluation`` (SURVEY.md
+§2.6 — expected paths, mount unavailable).
+"""
+
+from photon_ml_tpu.evaluation.evaluators import (
+    EvaluatorType,
+    auc,
+    better_than,
+    evaluate,
+    logistic_loss,
+    poisson_loss,
+    rmse,
+    squared_loss,
+)
+
+__all__ = [
+    "EvaluatorType",
+    "auc",
+    "better_than",
+    "evaluate",
+    "logistic_loss",
+    "poisson_loss",
+    "rmse",
+    "squared_loss",
+]

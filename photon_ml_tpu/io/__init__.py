@@ -1,0 +1,9 @@
+"""Data I/O: LIBSVM/CSV readers, feature indexing, model serialization.
+
+Reference: photon-api ``com.linkedin.photon.ml.io`` (SURVEY.md §2.4 —
+expected paths, mount unavailable).
+"""
+
+from photon_ml_tpu.io.libsvm import read_libsvm, write_libsvm
+
+__all__ = ["read_libsvm", "write_libsvm"]

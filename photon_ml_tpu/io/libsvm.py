@@ -1,0 +1,90 @@
+"""LIBSVM-format reader: the rebuild's a1a-class data path.
+
+Reference counterpart: ``AvroDataReader`` (photon-api
+``com.linkedin.photon.ml.io`` [expected path, mount unavailable — see
+SURVEY.md]) — the reference ingests Avro; its canonical small fixtures
+(a1a, heart-scale) are LIBSVM files converted to Avro.  The rebuild reads
+LIBSVM natively for parity fixtures and benchmarking; structured
+(Avro-equivalent) ingestion lives in ``photon_ml_tpu.io.dataset``.
+
+Output is host-side numpy (rows of (col_ids, values) + labels), which
+``make_sparse_batch`` / ``make_dense_batch`` turn into device-resident
+static-shape batches — the one host→HBM hop, after which training never
+touches the host again.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def read_libsvm(
+    path: str,
+    n_features: int | None = None,
+    zero_based: bool = False,
+    binary_labels_to_01: bool = True,
+) -> tuple[list[tuple[np.ndarray, np.ndarray]], np.ndarray, int]:
+    """Parse a LIBSVM file → (rows, labels, dim).
+
+    Args:
+      path: file path. Lines: ``label idx:val idx:val ...`` (# comments ok).
+      n_features: feature-space width; inferred as max index + 1 if None.
+      zero_based: whether indices in the file start at 0 (LIBSVM default
+        is 1-based, e.g. a1a).
+      binary_labels_to_01: map {-1,+1} labels to {0,1} (the reference's
+        binary-classification label convention).
+
+    Returns:
+      rows: per-example (col_ids int32[], values float32[]) with column
+        ids deduplicated (duplicate indices summed, as SparseBatch
+        requires unique ids per row).
+      labels: float32 [n].
+      dim: feature-space width.
+    """
+    rows: list[tuple[np.ndarray, np.ndarray]] = []
+    labels: list[float] = []
+    max_idx = -1
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            idxs, vals = [], []
+            for tok in parts[1:]:
+                i_str, v_str = tok.split(":")
+                i = int(i_str) - (0 if zero_based else 1)
+                idxs.append(i)
+                vals.append(float(v_str))
+            c = np.asarray(idxs, np.int32)
+            v = np.asarray(vals, np.float32)
+            if len(c):
+                max_idx = max(max_idx, int(c.max()))
+                if len(np.unique(c)) != len(c):
+                    # Sum duplicate indices so SparseBatch's unique-ids
+                    # invariant holds.
+                    c, inv = np.unique(c, return_inverse=True)
+                    v = np.bincount(inv, weights=v).astype(np.float32)
+            order = np.argsort(c)
+            rows.append((c[order], v[order]))
+
+    dim = n_features if n_features is not None else max_idx + 1
+    y = np.asarray(labels, np.float32)
+    if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:
+        y = (y + 1.0) / 2.0
+    return rows, y, dim
+
+
+def write_libsvm(
+    path: str,
+    rows: list[tuple[np.ndarray, np.ndarray]],
+    labels: np.ndarray,
+    zero_based: bool = False,
+) -> None:
+    """Inverse of ``read_libsvm`` (fixture generation / round-trip tests)."""
+    off = 0 if zero_based else 1
+    with open(path, "w") as f:
+        for (c, v), y in zip(rows, labels):
+            feats = " ".join(f"{int(i) + off}:{val:g}" for i, val in zip(c, v))
+            f.write(f"{y:g} {feats}\n".rstrip() + "\n")

@@ -1,0 +1,11 @@
+"""Model containers: Coefficients, GLMs, GAME models.
+
+Reference: photon-api ``com.linkedin.photon.ml.model`` /
+``...supervised.model`` (SURVEY.md §2.5 — expected paths, mount
+unavailable).
+"""
+
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel, TaskType
+
+__all__ = ["Coefficients", "GeneralizedLinearModel", "TaskType"]

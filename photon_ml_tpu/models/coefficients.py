@@ -1,0 +1,37 @@
+"""Coefficients: means (+ optional variances) of a linear model.
+
+Reference counterpart: ``Coefficients``
+(photon-api ``com.linkedin.photon.ml.model.Coefficients`` [expected path,
+mount unavailable — see SURVEY.md]).  Breeze vectors become JAX arrays;
+the container stays a pytree so it flows through jit/vmap/sharding (a
+``RandomEffectModel`` holds a *batched* Coefficients with a leading
+entity axis).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+Array = jax.Array
+
+
+@struct.dataclass
+class Coefficients:
+    """means [.., dim] and optional variances [.., dim] (reference:
+    variances from the Hessian diagonal, VarianceComputationType)."""
+
+    means: Array
+    variances: Array | None = None
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":
+        return Coefficients(means=jnp.zeros((dim,), dtype))
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def norm(self) -> Array:
+        return jnp.linalg.norm(self.means, axis=-1)

@@ -1,0 +1,58 @@
+"""Generalized linear models: per-task scoring on top of Coefficients.
+
+Reference counterparts: ``GeneralizedLinearModel`` and its per-task
+subclasses ``LogisticRegressionModel`` / ``LinearRegressionModel`` /
+``PoissonRegressionModel`` / ``SmoothedHingeLossLinearSVMModel``
+(photon-api ``com.linkedin.photon.ml.supervised.model`` [expected paths,
+mount unavailable — see SURVEY.md]).
+
+The Scala subclass-per-task hierarchy collapses into one pytree
+parameterized by ``TaskType``: the task selects the pointwise loss (and
+thus the mean/link function), which is exactly what distinguished the
+subclasses.  ``compute_score`` is the margin (dot product); mean-space
+prediction applies the link — matching the reference's score vs mean
+split used by scoring and evaluators.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+from flax import struct
+
+from photon_ml_tpu.data.batch import Batch
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.ops.losses import PointwiseLoss, get_loss
+
+Array = jax.Array
+
+
+class TaskType(str, enum.Enum):
+    """Reference ``TaskType`` enum."""
+
+    LOGISTIC_REGRESSION = "LOGISTIC_REGRESSION"
+    LINEAR_REGRESSION = "LINEAR_REGRESSION"
+    POISSON_REGRESSION = "POISSON_REGRESSION"
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM = "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+
+    @property
+    def loss(self) -> PointwiseLoss:
+        return get_loss(self.value)
+
+
+@struct.dataclass
+class GeneralizedLinearModel:
+    """A trained GLM: coefficients + task type (static)."""
+
+    coefficients: Coefficients
+    task: TaskType = struct.field(pytree_node=False)
+
+    def compute_score(self, batch: Batch) -> Array:
+        """Margins x·w + offset (reference ``computeScore``): the raw
+        score coordinate descent and loss evaluators consume."""
+        return batch.margins(self.coefficients.means)
+
+    def compute_mean(self, batch: Batch) -> Array:
+        """Mean-space prediction: link(margin) — sigmoid / identity / exp."""
+        return self.task.loss.mean(self.compute_score(batch))

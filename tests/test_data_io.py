@@ -1,0 +1,94 @@
+"""io/ + statistics tests: LIBSVM round-trip, stats vs numpy (SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import make_dense_batch, make_sparse_batch
+from photon_ml_tpu.data.normalization import (
+    NormalizationType,
+    compute_normalization,
+)
+from photon_ml_tpu.data.statistics import compute_statistics
+from photon_ml_tpu.io import read_libsvm, write_libsvm
+
+
+def _random_sparse_rows(rng, n, d, nnz):
+    rows = []
+    for _ in range(n):
+        k = rng.integers(1, nnz + 1)
+        cols = rng.choice(d, size=k, replace=False).astype(np.int32)
+        vals = rng.normal(0, 1, k).astype(np.float32)
+        rows.append((np.sort(cols), vals[np.argsort(cols)]))
+    return rows
+
+
+def test_libsvm_round_trip(rng, tmp_path):
+    n, d = 50, 30
+    rows = _random_sparse_rows(rng, n, d, 8)
+    labels = rng.choice([-1.0, 1.0], size=n)
+    path = str(tmp_path / "data.libsvm")
+    write_libsvm(path, rows, labels)
+    rows2, y2, dim2 = read_libsvm(path, n_features=d)
+    assert dim2 == d
+    np.testing.assert_array_equal(y2, (labels + 1) / 2)  # {-1,1} → {0,1}
+    for (c1, v1), (c2, v2) in zip(rows, rows2):
+        np.testing.assert_array_equal(c1, c2)
+        np.testing.assert_allclose(v1, v2, rtol=1e-5)
+
+
+def test_libsvm_sums_duplicate_indices(tmp_path):
+    path = str(tmp_path / "dup.libsvm")
+    with open(path, "w") as f:
+        f.write("1 3:1.5 3:2.5 7:1.0\n")
+    rows, y, dim = read_libsvm(path)
+    c, v = rows[0]
+    np.testing.assert_array_equal(c, [2, 6])
+    np.testing.assert_allclose(v, [4.0, 1.0])
+
+
+def test_statistics_dense_vs_numpy(rng):
+    n, d = 120, 9
+    x = rng.normal(1.0, 2.0, (n, d))
+    x[x < 0.5] = 0.0  # some sparsity for nnz counting
+    batch = make_dense_batch(x, np.zeros(n), pad_to=150)
+    stats = compute_statistics(batch)
+    assert float(stats.count) == n
+    np.testing.assert_allclose(stats.mean, x.mean(0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(stats.variance, x.var(0), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(stats.min, x.min(0), rtol=1e-5)
+    np.testing.assert_allclose(stats.max, x.max(0), rtol=1e-5)
+    np.testing.assert_allclose(
+        stats.max_abs, np.abs(x).max(0), rtol=1e-5
+    )
+    np.testing.assert_allclose(stats.num_nonzeros, (x != 0).sum(0))
+
+
+def test_statistics_sparse_matches_dense(rng):
+    n, d = 80, 20
+    rows = _random_sparse_rows(rng, n, d, 6)
+    labels = np.zeros(n)
+    sp = make_sparse_batch(rows, d, labels, pad_to=100)
+    dense_x = np.zeros((n, d), np.float32)
+    for i, (c, v) in enumerate(rows):
+        dense_x[i, c] = v
+    de = make_dense_batch(dense_x, labels, pad_to=100)
+    s_sp = compute_statistics(sp)
+    s_de = compute_statistics(de)
+    for field in ("mean", "variance", "min", "max", "max_abs", "num_nonzeros"):
+        np.testing.assert_allclose(
+            getattr(s_sp, field), getattr(s_de, field), rtol=1e-4, atol=1e-5,
+            err_msg=field,
+        )
+
+
+def test_stats_feed_normalization(rng):
+    n, d = 60, 5
+    x = rng.normal(3.0, 1.5, (n, d))
+    batch = make_dense_batch(x, np.zeros(n))
+    stats = compute_statistics(batch)
+    norm = compute_normalization(
+        stats.mean, stats.std, stats.max_abs,
+        NormalizationType.STANDARDIZATION,
+    )
+    np.testing.assert_allclose(norm.factors, 1.0 / x.std(0), rtol=1e-4)
+    np.testing.assert_allclose(norm.shifts, x.mean(0), rtol=1e-5)

@@ -1,0 +1,121 @@
+"""End-to-end BASELINE config-1 slice (SURVEY.md §7 stage 4).
+
+The driver-level integration tier (§4 tier 3): LIBSVM file on disk →
+reader → sparse batch → feature stats → normalization → L-BFGS fit →
+held-out AUC over a threshold → coefficients save/load round-trip.
+This is the permanent parity fixture for "fixed-effect logistic GLM on
+a1a (L-BFGS, L2 reg)".
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import make_sparse_batch
+from photon_ml_tpu.data.normalization import (
+    NormalizationType,
+    compute_normalization,
+)
+from photon_ml_tpu.data.statistics import compute_statistics
+from photon_ml_tpu.evaluation import auc
+from photon_ml_tpu.io import read_libsvm, write_libsvm
+from photon_ml_tpu.models import Coefficients, GeneralizedLinearModel, TaskType
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.optim import OptimizationProblem, OptimizerConfig
+from photon_ml_tpu.utils.synthetic import make_a1a_like
+
+
+def test_config1_a1a_end_to_end(tmp_path):
+    # --- fixture on disk (generated: no network; a1a-shaped) -------------
+    rows, labels, _ = make_a1a_like(n=3000)
+    path = str(tmp_path / "a1a_like.libsvm")
+    write_libsvm(path, rows, 2.0 * labels - 1.0)  # write as {-1,+1}
+
+    # --- read → split → batches -----------------------------------------
+    rows_r, y, dim = read_libsvm(path, n_features=123)
+    assert dim == 123 and len(rows_r) == 3000
+    n_train = 2000
+    train_rows, test_rows = rows_r[:n_train], rows_r[n_train:]
+    y_train, y_test = y[:n_train], y[n_train:]
+
+    train = make_sparse_batch(train_rows, dim, y_train)
+    test = make_sparse_batch(test_rows, dim, y_test)
+
+    # --- stats → normalization ------------------------------------------
+    stats = compute_statistics(train)
+    norm = compute_normalization(
+        stats.mean, stats.std, stats.max_abs,
+        NormalizationType.STANDARDIZATION,
+    )
+
+    # --- fit (config 1: logistic, L-BFGS, L2) ----------------------------
+    obj = GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=RegularizationContext.l2(1.0),
+        norm=norm,
+    )
+    problem = OptimizationProblem(
+        objective=obj,
+        config=OptimizerConfig(max_iters=200, tolerance=1e-6),
+    )
+    res = jax.jit(problem.run)(train, jnp.zeros(dim, jnp.float32))
+    assert bool(res.converged)
+
+    # --- model + held-out AUC -------------------------------------------
+    # Solution lives in normalized model space; store raw-space
+    # coefficients on the model so scoring needs no normalization context.
+    w_raw = norm.model_to_raw(res.w)
+    model = GeneralizedLinearModel(
+        coefficients=Coefficients(means=w_raw),
+        task=TaskType.LOGISTIC_REGRESSION,
+    )
+    margins = model.compute_score(test)
+    shift = norm.margin_correction(res.w)
+    test_auc = float(auc(margins - shift, test.labels, mask=test.mask))
+    assert test_auc >= 0.80, f"held-out AUC {test_auc:.4f} below gate"
+
+    # Train AUC should beat test slightly but both in the same class.
+    train_auc = float(
+        auc(model.compute_score(train) - shift, train.labels, mask=train.mask)
+    )
+    assert train_auc >= test_auc - 0.02
+
+    # --- save / load round trip ------------------------------------------
+    out = tmp_path / "model.npz"
+    np.savez(out, means=np.asarray(model.coefficients.means))
+    loaded = np.load(out)
+    np.testing.assert_array_equal(loaded["means"],
+                                  np.asarray(model.coefficients.means))
+
+
+def test_normalization_improves_conditioning_not_solution_quality(tmp_path):
+    """Normalized and raw fits must reach comparable AUC (the reference's
+    normalization changes conditioning, not the model class)."""
+    rows, labels, _ = make_a1a_like(n=1500, seed=13)
+    dim = 123
+    n_train = 1000
+    train = make_sparse_batch(rows[:n_train], dim, labels[:n_train])
+    test = make_sparse_batch(rows[n_train:], dim, labels[n_train:])
+
+    def fit_auc(norm):
+        obj = GLMObjective(
+            loss=losses.LOGISTIC, reg=RegularizationContext.l2(1.0), norm=norm
+        )
+        problem = OptimizationProblem(
+            objective=obj, config=OptimizerConfig(max_iters=200, tolerance=1e-6)
+        )
+        res = problem.run(train, jnp.zeros(dim, jnp.float32))
+        margins = test.margins(norm.model_to_raw(res.w)) - norm.margin_correction(res.w)
+        return float(auc(margins, test.labels, mask=test.mask))
+
+    from photon_ml_tpu.data.normalization import NormalizationContext
+
+    stats = compute_statistics(train)
+    auc_raw = fit_auc(NormalizationContext.identity())
+    auc_std = fit_auc(compute_normalization(
+        stats.mean, stats.std, stats.max_abs, NormalizationType.STANDARDIZATION
+    ))
+    assert abs(auc_raw - auc_std) < 0.02
+    assert min(auc_raw, auc_std) >= 0.78

@@ -1,0 +1,88 @@
+"""Evaluator unit tests vs sklearn hand-computed values (SURVEY.md §4
+tier 1: evaluator metrics vs hand computation)."""
+
+import jax.numpy as jnp
+import numpy as np
+import sklearn.metrics
+
+from photon_ml_tpu.evaluation import (
+    EvaluatorType,
+    auc,
+    better_than,
+    evaluate,
+    logistic_loss,
+    rmse,
+)
+
+
+def test_auc_matches_sklearn(rng):
+    n = 500
+    scores = rng.normal(0, 1, n)
+    labels = (rng.uniform(size=n) < 0.4).astype(np.float64)
+    ref = sklearn.metrics.roc_auc_score(labels, scores)
+    got = auc(jnp.asarray(scores), jnp.asarray(labels))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_auc_with_ties_matches_sklearn(rng):
+    n = 400
+    scores = rng.integers(0, 5, n).astype(np.float64)  # heavy ties
+    labels = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    ref = sklearn.metrics.roc_auc_score(labels, scores)
+    got = auc(jnp.asarray(scores), jnp.asarray(labels))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_auc_weighted_matches_sklearn(rng):
+    n = 300
+    scores = rng.normal(0, 1, n)
+    labels = (rng.uniform(size=n) < 0.3).astype(np.float64)
+    weights = rng.uniform(0.5, 3.0, n)
+    ref = sklearn.metrics.roc_auc_score(labels, scores, sample_weight=weights)
+    got = auc(jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_auc_mask_equals_subset(rng):
+    n = 200
+    scores = rng.normal(0, 1, n)
+    labels = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    mask = (rng.uniform(size=n) < 0.7).astype(np.float64)
+    got = auc(jnp.asarray(scores), jnp.asarray(labels), mask=jnp.asarray(mask))
+    keep = mask > 0
+    ref = sklearn.metrics.roc_auc_score(labels[keep], scores[keep])
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_auc_degenerate_single_class():
+    s = jnp.asarray([0.1, 0.5, 0.9])
+    assert float(auc(s, jnp.asarray([1.0, 1.0, 1.0]))) == 0.5
+    assert float(auc(s, jnp.asarray([0.0, 0.0, 0.0]))) == 0.5
+
+
+def test_rmse_and_logloss(rng):
+    n = 150
+    pred = rng.normal(0, 1, n)
+    y = rng.normal(0, 1, n)
+    np.testing.assert_allclose(
+        rmse(jnp.asarray(pred), jnp.asarray(y)),
+        np.sqrt(sklearn.metrics.mean_squared_error(y, pred)),
+        rtol=1e-6,
+    )
+    yb = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    margins = rng.normal(0, 2, n)
+    probs = 1 / (1 + np.exp(-margins))
+    np.testing.assert_allclose(
+        logistic_loss(jnp.asarray(margins), jnp.asarray(yb)),
+        sklearn.metrics.log_loss(yb, probs),
+        rtol=1e-5,
+    )
+
+
+def test_evaluate_dispatch_and_ordering(rng):
+    s = jnp.asarray(rng.normal(0, 1, 50))
+    y = jnp.asarray((rng.uniform(size=50) < 0.5).astype(np.float64))
+    a = evaluate(EvaluatorType.AUC, s, y)
+    assert 0.0 <= float(a) <= 1.0
+    assert bool(better_than(EvaluatorType.AUC, 0.9, 0.8))
+    assert bool(better_than(EvaluatorType.RMSE, 0.8, 0.9))
