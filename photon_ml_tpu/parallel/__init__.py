@@ -1,0 +1,27 @@
+"""Distribution: device meshes, sharded batches, the distributed objective.
+
+Reference: Spark runtime + ``DistributedGLMLossFunction`` (SURVEY.md
+§2.2/§5.8 — expected paths, mount unavailable).
+"""
+
+from photon_ml_tpu.parallel.distributed_objective import DistributedGLMObjective
+from photon_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    ENTITY_AXIS,
+    batch_spec,
+    data_parallel_mesh,
+    padded_rows,
+    replicate,
+    shard_batch,
+)
+
+__all__ = [
+    "DistributedGLMObjective",
+    "DATA_AXIS",
+    "ENTITY_AXIS",
+    "batch_spec",
+    "data_parallel_mesh",
+    "padded_rows",
+    "replicate",
+    "shard_batch",
+]

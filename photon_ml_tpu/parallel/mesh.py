@@ -1,0 +1,81 @@
+"""Device-mesh utilities: the TPU replacement for the Spark cluster.
+
+Reference counterpart: Spark's runtime substrate — executors, torrent
+broadcast, hash partitioning (SURVEY.md §5.8 [reference mount
+unavailable]).  The mapping:
+
+- executor set            → ``jax.sharding.Mesh`` over TPU chips (ICI)
+- ``broadcast(w)``        → replicated sharding ``P()`` (a no-op: every
+                            chip holds w; XLA keeps it resident in HBM)
+- ``partitionBy`` shuffle → a one-time host-side layout into batch shards
+                            (``shard_batch``), then static placement
+- ``treeAggregate``       → ``lax.psum`` over the mesh axis, riding ICI
+
+Axis names: ``"data"`` for example-parallelism (fixed effect) and
+``"entity"`` for entity-sharded random effects.  Multi-host scale-out
+uses the same meshes over ``jax.distributed``-initialized device sets —
+collectives then span DCN between slices with no code change.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.data.batch import Batch
+
+DATA_AXIS = "data"
+ENTITY_AXIS = "entity"
+
+
+def data_parallel_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)}"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (DATA_AXIS,))
+
+
+def batch_spec() -> P:
+    """PartitionSpec sharding the example axis (every Batch leaf has the
+    example dimension leading)."""
+    return P(DATA_AXIS)
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
+    """Place a host-built batch onto the mesh, example-axis sharded.
+
+    The batch must already be padded so n divides the mesh size
+    (``make_*_batch(pad_to=...)``); padding rows are masked, so shard
+    imbalance costs nothing but the pad FLOPs.  This is the rebuild's
+    "shuffle": it happens once, before training, not per-iteration.
+    """
+    n = batch.n_padded
+    n_dev = mesh.devices.size
+    if n % n_dev != 0:
+        raise ValueError(
+            f"batch rows {n} not divisible by mesh size {n_dev}; "
+            f"build the batch with pad_to=ceil(n/{n_dev})*{n_dev}"
+        )
+    sharding = NamedSharding(mesh, batch_spec())
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+
+
+def replicate(x, mesh: Mesh):
+    """Replicate an array (the coefficient 'broadcast')."""
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def padded_rows(n: int, n_devices: int) -> int:
+    """Smallest multiple of n_devices ≥ n."""
+    return ((n + n_devices - 1) // n_devices) * n_devices
