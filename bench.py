@@ -1,0 +1,131 @@
+"""Benchmark: fused GLM value+gradient pass at realistic sparse scale.
+
+Measures the framework's hot loop — the fused margin→loss→d1→scatter
+gradient pipeline (the reference's ``ValueAndGradientAggregator`` +
+``treeAggregate``, SURVEY.md §2.2) — on whatever accelerator jax
+provides (the driver runs this on one real TPU chip).
+
+Workload: n=1,000,000 examples, d=100,000 features, k=30 nnz/row padded
+ELL (KDD-2012-class sparsity).  Metric: examples/sec through one full
+value+gradient evaluation (the unit of work per optimizer iteration).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+The reference publishes no benchmark numbers (BASELINE.md), so
+``vs_baseline`` is the ratio against the framework's own non-fused
+two-pass XLA formulation (value pass + separate gradient pass) — the
+naive implementation a straight port would produce; >1 means the fused
+design wins.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _make_ell(n: int, d: int, k: int, seed: int = 0):
+    """Vectorized synthetic ELL batch: unique col ids per row by
+    stratified sampling (one column per d/k-wide block)."""
+    rng = np.random.default_rng(seed)
+    block = d // k
+    cols = (np.arange(k, dtype=np.int64) * block)[None, :] + rng.integers(
+        0, block, (n, k)
+    )
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    labels = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    return cols.astype(np.int32), vals, labels
+
+
+def _time_fn(fn, *args, iters: int = 20) -> float:
+    """Median wall-clock seconds per call (after warmup compile)."""
+    out = fn(*args)
+    jax_block(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax_block(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def jax_block(out):
+    import jax
+
+    jax.block_until_ready(out)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import SparseBatch
+    from photon_ml_tpu.data.normalization import NormalizationContext
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+
+    n, d, k = 1_000_000, 100_000, 30
+    platform = jax.devices()[0].platform
+    print(f"platform={platform} n={n} d={d} k={k}", file=sys.stderr)
+
+    cols, vals, labels = _make_ell(n, d, k)
+    batch = SparseBatch(
+        values=jnp.asarray(vals),
+        col_ids=jnp.asarray(cols),
+        labels=jnp.asarray(labels),
+        weights=jnp.ones((n,), jnp.float32),
+        offsets=jnp.zeros((n,), jnp.float32),
+        mask=jnp.ones((n,), jnp.float32),
+        dim=d,
+    )
+    obj = GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=RegularizationContext.l2(1.0),
+        norm=NormalizationContext.identity(),
+    )
+    w = jnp.asarray(np.random.default_rng(1).normal(0, 0.1, d), jnp.float32)
+
+    # Fused single-pass value+gradient (the framework's design).
+    fused = jax.jit(obj.value_and_gradient)
+
+    # Naive two-pass baseline: separate value pass and autodiff gradient
+    # pass (what a non-fused port of the reference's aggregator would do).
+    value_only = jax.jit(obj.value)
+    grad_only = jax.jit(jax.grad(obj.value))
+
+    def two_pass(w, batch):
+        return value_only(w, batch), grad_only(w, batch)
+
+    t_fused = _time_fn(fused, w, batch)
+    t_naive = _time_fn(two_pass, w, batch)
+
+    examples_per_sec = n / t_fused
+    # HBM traffic estimate for the fused pass: read values+col_ids twice
+    # (margin pass + grad pass) + per-row vectors + [d] gradient writes.
+    bytes_moved = 2 * (n * k * 8) + 5 * n * 4 + 3 * d * 4
+    gb_per_sec = bytes_moved / t_fused / 1e9
+
+    print(
+        f"fused={t_fused * 1e3:.2f}ms naive={t_naive * 1e3:.2f}ms "
+        f"examples/s={examples_per_sec:.3e} est-BW={gb_per_sec:.1f}GB/s",
+        file=sys.stderr,
+    )
+
+    print(json.dumps({
+        "metric": "fused sparse GLM value+gradient throughput "
+                  f"(n=1e6,d=1e5,k=30,{platform})",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(t_naive / t_fused, 3),
+        "step_ms": round(t_fused * 1e3, 3),
+        "naive_two_pass_ms": round(t_naive * 1e3, 3),
+        "est_hbm_gb_per_sec": round(gb_per_sec, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
