@@ -1,0 +1,37 @@
+"""GAME: generalized additive mixed effects — coordinates + descent.
+
+Reference: photon-api ``com.linkedin.photon.ml.algorithm`` / ``...data``
+(SURVEY.md §2.3/§2.4 — expected paths, mount unavailable).
+"""
+
+from photon_ml_tpu.game.coordinate_descent import (
+    CoordinateDescentResult,
+    run_coordinate_descent,
+)
+from photon_ml_tpu.game.coordinates import (
+    Coordinate,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+    build_random_effect_coordinate,
+)
+from photon_ml_tpu.game.dataset import (
+    EntityGrouping,
+    GameDataset,
+    gather_from_blocks,
+    group_by_entity,
+    scatter_to_blocks,
+)
+
+__all__ = [
+    "CoordinateDescentResult",
+    "run_coordinate_descent",
+    "Coordinate",
+    "FixedEffectCoordinate",
+    "RandomEffectCoordinate",
+    "build_random_effect_coordinate",
+    "EntityGrouping",
+    "GameDataset",
+    "gather_from_blocks",
+    "group_by_entity",
+    "scatter_to_blocks",
+]

@@ -1,0 +1,264 @@
+"""GAME coordinates: the per-coordinate train/score units.
+
+Reference counterparts: ``Coordinate``, ``FixedEffectCoordinate``,
+``RandomEffectCoordinate`` (photon-api
+``com.linkedin.photon.ml.algorithm`` [expected paths, mount unavailable —
+see SURVEY.md §2.3]).
+
+The reference contract carries over exactly — ``train(offsets, warm
+start) → model`` and ``score(model) → per-example scores`` — but the
+execution model flips:
+
+- ``FixedEffectCoordinate``: the reference runs
+  ``DistributedOptimizationProblem`` (broadcast + treeAggregate per
+  L-BFGS iteration).  Here the SAME ``OptimizationProblem`` runs over
+  either a local batch or a mesh-sharded batch wrapped in
+  ``DistributedGLMObjective`` — one jitted solve either way.
+- ``RandomEffectCoordinate``: the reference's
+  ``RDD[(REId, LocalDataset)].mapValues(solve per entity)`` — thousands
+  of sequential JVM L-BFGS loops per partition — becomes ONE
+  ``vmap``ped solve per size bucket: every entity in a bucket optimizes
+  simultaneously on the VPU/MXU, each converging by its own criterion
+  (masked while_loop).  Entity blocks are built once by the host ETL
+  (``EntityGrouping``); per-CD-iteration offsets move between example
+  space and block space by static-index gather/scatter on device.
+
+Scores are raw dot products x·w (no offset, no link), summable across
+coordinates — the reference's ``CoordinateDataScores`` convention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import Batch, DenseBatch
+from photon_ml_tpu.game.dataset import (
+    EntityGrouping,
+    GameDataset,
+    group_by_entity,
+)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.optim import OptimizationProblem, OptimizerConfig
+from photon_ml_tpu.optim.lbfgs import lbfgs_solve
+from photon_ml_tpu.optim.tron import tron_solve
+from photon_ml_tpu.parallel.distributed_objective import DistributedGLMObjective
+
+Array = jax.Array
+
+
+class Coordinate:
+    """train/score contract (reference ``Coordinate`` abstraction)."""
+
+    name: str
+
+    def initial_coefficients(self):
+        raise NotImplementedError
+
+    def train(self, offsets: Array, warm_start):
+        """offsets [n] (residual scores from other coordinates) → (model
+        coefficients, optimizer diagnostics)."""
+        raise NotImplementedError
+
+    def score(self, coefficients) -> Array:
+        """coefficients → per-example scores [n]."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(eq=False)
+class FixedEffectCoordinate(Coordinate):
+    """Global solve over the full batch (reference
+    ``FixedEffectCoordinate`` + ``DistributedOptimizationProblem``)."""
+
+    name: str
+    batch: Batch                      # local or mesh-sharded
+    problem: OptimizationProblem
+    distributed: DistributedGLMObjective | None = None  # set if sharded
+
+    def initial_coefficients(self) -> Array:
+        return jnp.zeros((self.batch.dim,), jnp.float32)
+
+    @partial(jax.jit, static_argnums=0)
+    def _train_jit(self, offsets: Array, w0: Array):
+        batch = self.batch.replace(offsets=offsets)
+        if self.distributed is None:
+            return self.problem.run(batch, w0)
+        # Same solver over the psum-reduced objective.
+        obj = self.distributed
+        vg = lambda w: obj.value_and_gradient(w, batch)
+        from photon_ml_tpu.optim.base import OptimizerType
+
+        if self.problem.optimizer == OptimizerType.TRON:
+            hvp = lambda w, v: obj.hessian_vector(w, v, batch)
+            return tron_solve(vg, hvp, w0, self.problem.config)
+        return lbfgs_solve(
+            vg, w0, self.problem.config,
+            l1_weight=self.problem._l1_vector(w0.shape[-1]),
+        )
+
+    def train(self, offsets: Array, warm_start: Array | None = None):
+        w0 = self.initial_coefficients() if warm_start is None else warm_start
+        res = self._train_jit(offsets, w0)
+        return res.w, res
+
+    @partial(jax.jit, static_argnums=0)
+    def score(self, coefficients: Array) -> Array:
+        return self.batch.x_dot(coefficients)
+
+    def as_model(self, coefficients: Array) -> FixedEffectModel:
+        return FixedEffectModel(
+            coefficients=Coefficients(means=coefficients),
+            feature_shard=self.name,
+        )
+
+
+@dataclasses.dataclass(eq=False)
+class RandomEffectCoordinate(Coordinate):
+    """Entity-sharded solves, one vmapped batch per size bucket
+    (reference ``RandomEffectCoordinate``)."""
+
+    name: str
+    grouping: EntityGrouping
+    # Per-bucket device arrays (built by ``build_random_effect_coordinate``):
+    x_blocks: list[Array]        # [E_b, cap_b, d_re]
+    label_blocks: list[Array]    # [E_b, cap_b]
+    weight_blocks: list[Array]   # [E_b, cap_b]
+    mask_blocks: list[Array]     # [E_b, cap_b]
+    # Static per-bucket example-index maps (example space ↔ block space):
+    ex_idx: list[Array]          # [n_b] example positions in this bucket
+    row_idx: list[Array]         # [n_b] entity slot
+    col_idx: list[Array]         # [n_b] within-entity position
+    # Per-example gather map for scoring:
+    x_re: Array                  # [n, d_re] per-example RE features
+    example_entity: Array        # [n] global entity index per example
+    bucket_global_idx: list[Array]  # per bucket: [E_b] global entity idx
+    problem: OptimizationProblem
+
+    @property
+    def dim(self) -> int:
+        return self.x_blocks[0].shape[-1]
+
+    def initial_coefficients(self) -> list[Array]:
+        return [
+            jnp.zeros((blk.shape[0], self.dim), jnp.float32)
+            for blk in self.x_blocks
+        ]
+
+    @partial(jax.jit, static_argnums=0)
+    def _train_jit(self, offsets: Array, w0s: list[Array]):
+        outs = []
+        for b in range(len(self.x_blocks)):
+            off_blk = jnp.zeros_like(self.label_blocks[b]).at[
+                self.row_idx[b], self.col_idx[b]
+            ].set(offsets[self.ex_idx[b]])
+            batch_b = DenseBatch(
+                x=self.x_blocks[b],
+                labels=self.label_blocks[b],
+                weights=self.weight_blocks[b],
+                offsets=off_blk,
+                mask=self.mask_blocks[b],
+            )
+            res = jax.vmap(self.problem.run)(batch_b, w0s[b])
+            outs.append(res)
+        return outs
+
+    def train(self, offsets: Array, warm_start=None):
+        w0s = self.initial_coefficients() if warm_start is None else warm_start
+        results = self._train_jit(offsets, w0s)
+        return [r.w for r in results], results
+
+    @partial(jax.jit, static_argnums=0)
+    def score(self, coefficient_blocks: list[Array]) -> Array:
+        w_all = jnp.zeros((self.grouping.n_total_entities, self.dim),
+                          jnp.float32)
+        for b, blk in enumerate(coefficient_blocks):
+            w_all = w_all.at[self.bucket_global_idx[b]].set(blk)
+        w_per_example = w_all[self.example_entity]          # [n, d_re]
+        return jnp.sum(self.x_re * w_per_example, axis=-1)  # [n]
+
+    def as_model(self, coefficient_blocks: list[Array]) -> RandomEffectModel:
+        return RandomEffectModel(
+            coefficient_blocks=coefficient_blocks,
+            grouping=self.grouping,
+            feature_shard=self.name,
+        )
+
+
+def build_random_effect_coordinate(
+    name: str,
+    dataset: GameDataset,
+    feature_shard: str,
+    objective: GLMObjective,
+    config: OptimizerConfig | None = None,
+    optimizer=None,
+    bucket_base: int = 4,
+) -> RandomEffectCoordinate:
+    """Host ETL → device blocks: the reference's partition-and-group
+    pipeline (``RandomEffectDataset.apply``) as one deterministic pass."""
+    from photon_ml_tpu.optim.base import OptimizerType
+
+    x = np.asarray(dataset.features[feature_shard], np.float32)
+    entity_ids = dataset.entity_ids[name]
+    grouping = group_by_entity(entity_ids, bucket_base=bucket_base)
+
+    labels = dataset.labels.astype(np.float32)
+    weights = dataset.weight_array()
+
+    x_blocks, lab_blocks, wt_blocks, mask_blocks = [], [], [], []
+    ex_idx, row_idx, col_idx, bucket_gidx = [], [], [], []
+    for b, (cap, ne) in enumerate(zip(grouping.capacities,
+                                      grouping.n_entities)):
+        sel = np.where(grouping.example_bucket == b)[0]
+        rows = grouping.example_row[sel]
+        cols = grouping.example_col[sel]
+        xb = np.zeros((ne, cap, x.shape[1]), np.float32)
+        lb = np.zeros((ne, cap), np.float32)
+        wb = np.zeros((ne, cap), np.float32)
+        mb = np.zeros((ne, cap), np.float32)
+        xb[rows, cols] = x[sel]
+        lb[rows, cols] = labels[sel]
+        wb[rows, cols] = weights[sel]
+        mb[rows, cols] = 1.0
+        x_blocks.append(jnp.asarray(xb))
+        lab_blocks.append(jnp.asarray(lb))
+        wt_blocks.append(jnp.asarray(wb))
+        mask_blocks.append(jnp.asarray(mb))
+        ex_idx.append(jnp.asarray(sel.astype(np.int32)))
+        row_idx.append(jnp.asarray(rows.astype(np.int32)))
+        col_idx.append(jnp.asarray(cols.astype(np.int32)))
+        bucket_gidx.append(jnp.asarray(
+            np.where(grouping.entity_bucket == b)[0].astype(np.int32)
+        ))
+
+    # Global entity index per example (unique-id order).
+    uniq_pos = {int(e): i for i, e in enumerate(grouping.entity_ids)}
+    example_entity = np.asarray(
+        [uniq_pos[int(e)] for e in entity_ids], np.int32
+    )
+
+    problem = OptimizationProblem(
+        objective=objective,
+        optimizer=optimizer or OptimizerType.LBFGS,
+        config=config or OptimizerConfig(),
+    )
+    return RandomEffectCoordinate(
+        name=name,
+        grouping=grouping,
+        x_blocks=x_blocks,
+        label_blocks=lab_blocks,
+        weight_blocks=wt_blocks,
+        mask_blocks=mask_blocks,
+        ex_idx=ex_idx,
+        row_idx=row_idx,
+        col_idx=col_idx,
+        x_re=jnp.asarray(x),
+        example_entity=jnp.asarray(example_entity),
+        bucket_global_idx=bucket_gidx,
+        problem=problem,
+    )

@@ -1,0 +1,198 @@
+"""GAME datasets: feature-sharded examples + entity-grouped blocks.
+
+Reference counterparts: ``GameDatum``, ``FixedEffectDataset``,
+``RandomEffectDataset``, ``LocalDataset``,
+``RandomEffectDatasetPartitioner`` (photon-api
+``com.linkedin.photon.ml.data`` [expected paths, mount unavailable — see
+SURVEY.md §2.4]).
+
+Design translation (SURVEY §7 stage 6):
+
+- The reference's ``RDD[GameDatum]`` becomes a host-side ``GameDataset``:
+  per-shard feature arrays + per-coordinate entity ids, all indexed by
+  example position (the ``UniqueSampleId`` is literally the array index).
+- The reference's shuffle (``partitionBy(RandomEffectDatasetPartitioner)``
+  + ``groupBy(REId)``) becomes a ONE-TIME host ETL
+  (``group_by_entity``): a stable sort by entity id yielding a
+  permutation + per-example (block_row, block_col) coordinates into
+  padded per-entity blocks.  After this, training-time regrouping is
+  pure static-shape gather/scatter on device — no per-step shuffle.
+- Power-law entity skew (the rebuild's hardest static-shape problem) is
+  handled by **size-bucketing**: entities are binned by example count
+  into capacity buckets (powers-of-bucket_base), one padded block array
+  per bucket, so padding waste is bounded by bucket_base× instead of
+  max-entity×.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class EntityGrouping:
+    """Host-side grouping of n examples into per-entity padded blocks.
+
+    Entities are ordered by example count (descending) and assigned to
+    capacity buckets.  Bucket b holds ``n_entities[b]`` entities with
+    capacity ``capacities[b]`` examples each; entity slots within a
+    bucket are dense.  Per-example coordinates map example i to
+    ``(bucket[i], row[i], col[i])`` — row is the entity's slot in its
+    bucket, col the example's position within the entity's block.
+    """
+
+    n_examples: int
+    # Per-entity (global entity order: unique ids sorted):
+    entity_ids: np.ndarray      # [E] original ids (as passed in)
+    entity_counts: np.ndarray   # [E] examples per entity
+    entity_bucket: np.ndarray   # [E] bucket index per entity
+    entity_slot: np.ndarray     # [E] slot within its bucket
+    # Per-bucket:
+    capacities: list[int]       # examples capacity per entity block
+    n_entities: list[int]       # entities per bucket
+    # Per-example:
+    example_bucket: np.ndarray  # [n]
+    example_row: np.ndarray     # [n] entity slot in bucket
+    example_col: np.ndarray     # [n] position within entity block
+
+    @property
+    def n_total_entities(self) -> int:
+        return len(self.entity_ids)
+
+    def entity_index(self) -> dict:
+        """original entity id → (bucket, slot)."""
+        return {
+            int(e): (int(b), int(s))
+            for e, b, s in zip(self.entity_ids, self.entity_bucket,
+                               self.entity_slot)
+        }
+
+
+def group_by_entity(
+    entity_ids: np.ndarray,
+    bucket_base: int = 4,
+    min_capacity: int = 4,
+) -> EntityGrouping:
+    """Group example indices by entity with size-bucketed capacities.
+
+    Bucket capacities are min_capacity·bucket_base^j, so within-bucket
+    padding waste is < bucket_base×.  Deterministic given inputs.
+    """
+    entity_ids = np.asarray(entity_ids)
+    n = len(entity_ids)
+    uniq, inverse, counts = np.unique(
+        entity_ids, return_inverse=True, return_counts=True
+    )
+    E = len(uniq)
+
+    # Capacity per entity: smallest bucket capacity ≥ count.
+    caps_needed = np.maximum(counts, 1)
+    bucket_of_entity = np.zeros(E, np.int64)
+    cap = min_capacity
+    cap_list = [min_capacity]
+    while cap < caps_needed.max():
+        cap *= bucket_base
+        cap_list.append(cap)
+    cap_arr = np.asarray(cap_list)
+    bucket_of_entity = np.searchsorted(cap_arr, caps_needed, side="left")
+
+    # Keep only non-empty buckets, re-indexed densely.
+    used = np.unique(bucket_of_entity)
+    remap = {int(b): i for i, b in enumerate(used)}
+    bucket_of_entity = np.asarray([remap[int(b)] for b in bucket_of_entity])
+    capacities = [int(cap_arr[b]) for b in used]
+
+    # Slot of each entity within its bucket (stable order by entity id).
+    n_buckets = len(used)
+    slot_of_entity = np.zeros(E, np.int64)
+    n_entities = []
+    for b in range(n_buckets):
+        members = np.where(bucket_of_entity == b)[0]
+        slot_of_entity[members] = np.arange(len(members))
+        n_entities.append(len(members))
+
+    # Per-example coordinates: position within its entity via stable sort.
+    order = np.argsort(inverse, kind="stable")
+    col = np.empty(n, np.int64)
+    # positions 0..count-1 within each entity, in original example order
+    # for determinism (stable sort preserves original order).
+    start = 0
+    for e in range(E):
+        c = counts[e]
+        col[order[start:start + c]] = np.arange(c)
+        start += c
+
+    ex_entity = inverse
+    return EntityGrouping(
+        n_examples=n,
+        entity_ids=uniq,
+        entity_counts=counts,
+        entity_bucket=bucket_of_entity,
+        entity_slot=slot_of_entity,
+        capacities=capacities,
+        n_entities=n_entities,
+        example_bucket=bucket_of_entity[ex_entity],
+        example_row=slot_of_entity[ex_entity],
+        example_col=col,
+    )
+
+
+def scatter_to_blocks(
+    grouping: EntityGrouping, values: np.ndarray, fill: float = 0.0
+) -> list[np.ndarray]:
+    """Per-example values [n, ...] → per-bucket blocks [E_b, cap_b, ...]."""
+    out = []
+    trailing = values.shape[1:]
+    for b, (cap, ne) in enumerate(
+        zip(grouping.capacities, grouping.n_entities)
+    ):
+        blk = np.full((ne, cap) + trailing, fill, values.dtype)
+        sel = grouping.example_bucket == b
+        blk[grouping.example_row[sel], grouping.example_col[sel]] = values[sel]
+        out.append(blk)
+    return out
+
+
+def gather_from_blocks(
+    grouping: EntityGrouping, blocks: list[np.ndarray]
+) -> np.ndarray:
+    """Inverse of ``scatter_to_blocks`` (real example slots only)."""
+    trailing = blocks[0].shape[2:]
+    out = np.zeros((grouping.n_examples,) + trailing, blocks[0].dtype)
+    for b, blk in enumerate(blocks):
+        sel = grouping.example_bucket == b
+        out[sel] = blk[grouping.example_row[sel], grouping.example_col[sel]]
+    return out
+
+
+@dataclasses.dataclass
+class GameDataset:
+    """Host-side GAME data: per-shard features + per-coordinate entity ids.
+
+    The reference's ``GameDatum`` fields map to parallel arrays indexed
+    by example position: ``labels/weights/offsets`` [n], feature shards
+    (dense [n, d_shard] here; sparse shards enter via
+    ``make_sparse_batch`` on the fixed-effect path), and
+    ``entity_ids[coordinate]`` [n] integer ids (the reference's REId
+    tags, pre-indexed by the feature/id maps).
+    """
+
+    labels: np.ndarray
+    features: dict  # shard name → [n, d] float array (or sparse rows list)
+    entity_ids: dict  # random-effect coordinate name → [n] int array
+    weights: np.ndarray | None = None
+    offsets: np.ndarray | None = None
+
+    @property
+    def n(self) -> int:
+        return len(self.labels)
+
+    def weight_array(self) -> np.ndarray:
+        return (np.ones(self.n, np.float32) if self.weights is None
+                else self.weights.astype(np.float32))
+
+    def offset_array(self) -> np.ndarray:
+        return (np.zeros(self.n, np.float32) if self.offsets is None
+                else self.offsets.astype(np.float32))

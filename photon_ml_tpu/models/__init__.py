@@ -6,6 +6,18 @@ unavailable).
 """
 
 from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
 from photon_ml_tpu.models.glm import GeneralizedLinearModel, TaskType
 
-__all__ = ["Coefficients", "GeneralizedLinearModel", "TaskType"]
+__all__ = [
+    "Coefficients",
+    "FixedEffectModel",
+    "GameModel",
+    "RandomEffectModel",
+    "GeneralizedLinearModel",
+    "TaskType",
+]

@@ -1,0 +1,102 @@
+"""GAME model containers: fixed-effect + random-effect + composite.
+
+Reference counterparts: ``GameModel``, ``FixedEffectModel``,
+``RandomEffectModel`` (photon-api ``com.linkedin.photon.ml.model``
+[expected paths, mount unavailable — see SURVEY.md §2.5]).
+
+Mapping to TPU-resident state:
+
+- ``FixedEffectModel``: broadcast Breeze vector → replicated [dim] array.
+- ``RandomEffectModel``: ``RDD[(REId, Coefficients)]`` → per-bucket
+  dense coefficient blocks [E_b, d_re] (the entity axis is shardable
+  over the mesh's entity axis), plus host-side id metadata from the
+  ``EntityGrouping``.
+- ``GameModel``: ordered coordinate → model map (order = update order).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.models.coefficients import Coefficients
+
+if TYPE_CHECKING:  # import would cycle through the game package at runtime
+    from photon_ml_tpu.game.dataset import EntityGrouping
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class FixedEffectModel:
+    """Global coefficients for one feature shard."""
+
+    coefficients: Coefficients
+    feature_shard: str = "global"
+
+    @property
+    def dim(self) -> int:
+        return self.coefficients.dim
+
+
+@dataclasses.dataclass
+class RandomEffectModel:
+    """Per-entity coefficients, stored as size-bucketed blocks.
+
+    ``coefficient_blocks[b]`` is [E_b, d_re] for bucket b of the
+    grouping; ``grouping`` maps original entity ids to (bucket, slot).
+    Entities never seen in training score zero (the reference's behavior
+    for missing REIds: only the fixed effect + other coordinates apply).
+    """
+
+    coefficient_blocks: list[Array]
+    grouping: EntityGrouping
+    feature_shard: str
+    variance_blocks: list[Array] | None = None
+
+    @property
+    def dim(self) -> int:
+        return self.coefficient_blocks[0].shape[-1]
+
+    @property
+    def n_entities(self) -> int:
+        return self.grouping.n_total_entities
+
+    def coefficients_for(self, entity_id) -> np.ndarray | None:
+        """Host-side per-entity lookup (model inspection / serialization)."""
+        idx = self.grouping.entity_index().get(int(entity_id))
+        if idx is None:
+            return None
+        b, s = idx
+        return np.asarray(self.coefficient_blocks[b][s])
+
+    def all_coefficients(self) -> Array:
+        """[E_total, d_re] in global entity order (unique-id sorted) —
+        the gatherable form scoring uses."""
+        out = jnp.zeros((self.n_entities, self.dim),
+                        self.coefficient_blocks[0].dtype)
+        for b, blk in enumerate(self.coefficient_blocks):
+            global_idx = np.where(self.grouping.entity_bucket == b)[0]
+            out = out.at[jnp.asarray(global_idx)].set(blk)
+        return out
+
+
+@dataclasses.dataclass
+class GameModel:
+    """Ordered coordinate name → component model (reference ``GameModel``)."""
+
+    models: dict  # name → FixedEffectModel | RandomEffectModel
+
+    def __getitem__(self, name: str):
+        return self.models[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.models
+
+    @property
+    def coordinate_names(self) -> list[str]:
+        return list(self.models.keys())

@@ -1,0 +1,220 @@
+"""GAME layer tests: grouping ETL, random-effect solves, coordinate descent.
+
+Mirrors the reference's integration tier (CoordinateDescentIntegTest,
+RandomEffectDatasetIntegTest — SURVEY.md §4): BASELINE config-4 gate is
+fixed+RE beating fixed-only AUC on mixed-effect data.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.batch import make_dense_batch
+from photon_ml_tpu.data.normalization import NormalizationContext
+from photon_ml_tpu.evaluation import auc
+from photon_ml_tpu.game import (
+    GameDataset,
+    FixedEffectCoordinate,
+    build_random_effect_coordinate,
+    gather_from_blocks,
+    group_by_entity,
+    run_coordinate_descent,
+    scatter_to_blocks,
+)
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.optim import OptimizationProblem, OptimizerConfig
+from photon_ml_tpu.utils.synthetic import make_movielens_like
+
+
+# ---------------------------------------------------------------------------
+# Grouping ETL
+# ---------------------------------------------------------------------------
+
+def test_group_by_entity_structure(rng):
+    ids = rng.integers(0, 50, 1000)
+    g = group_by_entity(ids, bucket_base=4, min_capacity=4)
+    assert g.n_examples == 1000
+    assert g.n_total_entities == len(np.unique(ids))
+    # Every entity's count fits its bucket capacity.
+    for e in range(g.n_total_entities):
+        assert g.entity_counts[e] <= g.capacities[g.entity_bucket[e]]
+    # Example coordinates are consistent: same entity → same (bucket, row).
+    for i in rng.choice(1000, 50, replace=False):
+        e = np.searchsorted(g.entity_ids, ids[i])
+        assert g.example_bucket[i] == g.entity_bucket[e]
+        assert g.example_row[i] == g.entity_slot[e]
+        assert g.example_col[i] < g.entity_counts[e]
+
+
+def test_scatter_gather_round_trip(rng):
+    ids = rng.integers(0, 30, 500)
+    g = group_by_entity(ids)
+    vals = rng.normal(0, 1, 500).astype(np.float32)
+    blocks = scatter_to_blocks(g, vals)
+    back = gather_from_blocks(g, blocks)
+    np.testing.assert_array_equal(back, vals)
+
+
+def test_power_law_bucketing_bounds_padding(rng):
+    # Zipf-ish entity sizes: bucketing must keep padding < base× data.
+    sizes = np.maximum(1, (2000 / np.arange(1, 201) ** 1.2)).astype(int)
+    ids = np.repeat(np.arange(200), sizes)
+    g = group_by_entity(ids, bucket_base=4)
+    padded = sum(c * ne for c, ne in zip(g.capacities, g.n_entities))
+    assert padded < 4 * len(ids) + 4 * 200
+
+
+# ---------------------------------------------------------------------------
+# Random-effect coordinate
+# ---------------------------------------------------------------------------
+
+def _re_objective(l2=1.0):
+    return GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=RegularizationContext.l2(l2),
+        norm=NormalizationContext.identity(),
+    )
+
+
+def test_random_effect_recovers_entity_effects(rng):
+    """Per-entity intercept-only logistic: vmapped solves must recover
+    each entity's effect sign/magnitude."""
+    n_entities, per_entity = 40, 60
+    effects = rng.normal(0, 1.5, n_entities)
+    ids = np.repeat(np.arange(n_entities), per_entity)
+    p = 1 / (1 + np.exp(-effects[ids]))
+    y = (rng.uniform(size=len(ids)) < p).astype(np.float32)
+    ds = GameDataset(
+        labels=y,
+        features={"re": np.ones((len(ids), 1), np.float32)},
+        entity_ids={"per_entity": ids},
+    )
+    coord = build_random_effect_coordinate(
+        "per_entity", ds, "re", _re_objective(l2=2.0),
+        config=OptimizerConfig(max_iters=50, tolerance=1e-6,
+                               track_states=False),
+    )
+    blocks, results = coord.train(jnp.zeros(len(ids), jnp.float32))
+    assert all(bool(jnp.all(r.converged)) for r in results)
+    model = coord.as_model(blocks)
+    learned = np.array([
+        model.coefficients_for(e)[0] for e in range(n_entities)
+    ])
+    # Shrinkage from L2 means magnitudes compress; correlation stays high.
+    assert np.corrcoef(learned, effects)[0, 1] > 0.85
+
+
+def test_random_effect_scores_match_per_entity_dot(rng):
+    n = 400
+    ids = rng.integers(0, 25, n)
+    x = rng.normal(0, 1, (n, 3)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    ds = GameDataset(labels=y, features={"re": x},
+                     entity_ids={"u": ids})
+    coord = build_random_effect_coordinate(
+        "u", ds, "re", _re_objective(),
+        config=OptimizerConfig(max_iters=30, tolerance=1e-5,
+                               track_states=False),
+    )
+    blocks, _ = coord.train(jnp.zeros(n, jnp.float32))
+    scores = np.asarray(coord.score(blocks))
+    model = coord.as_model(blocks)
+    for i in rng.choice(n, 25, replace=False):
+        w_e = model.coefficients_for(ids[i])
+        np.testing.assert_allclose(scores[i], x[i] @ w_e, rtol=1e-4,
+                                   atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Coordinate descent (BASELINE config 4 gate)
+# ---------------------------------------------------------------------------
+
+def _movielens_coordinates(data, l2_fixed=1.0, l2_re=2.0):
+    n = len(data["labels"])
+    fixed_batch = make_dense_batch(data["x"], data["labels"])
+    fixed = FixedEffectCoordinate(
+        name="global",
+        batch=fixed_batch,
+        problem=OptimizationProblem(
+            objective=GLMObjective(
+                loss=losses.LOGISTIC,
+                reg=RegularizationContext.l2(l2_fixed),
+                norm=NormalizationContext.identity(),
+            ),
+            config=OptimizerConfig(max_iters=100, tolerance=1e-6,
+                                   track_states=False),
+        ),
+    )
+    ds = GameDataset(
+        labels=data["labels"],
+        features={
+            "global": data["x"],
+            "user_re": np.ones((n, 1), np.float32),
+        },
+        entity_ids={"per_user": data["user_ids"]},
+    )
+    user_re = build_random_effect_coordinate(
+        "per_user", ds, "user_re", _re_objective(l2=l2_re),
+        config=OptimizerConfig(max_iters=50, tolerance=1e-6,
+                               track_states=False),
+    )
+    return fixed, user_re
+
+
+def test_game_beats_fixed_only(rng):
+    data = make_movielens_like(n_users=150, n_items=1, n_obs=6000)
+    labels = jnp.asarray(data["labels"])
+
+    fixed, user_re = _movielens_coordinates(data)
+
+    # Fixed-effect-only AUC.
+    w_fixed, _ = fixed.train(jnp.zeros(len(data["labels"]), jnp.float32))
+    auc_fixed = float(auc(fixed.score(w_fixed), labels))
+
+    # GAME: fixed + per-user random effect.
+    result = run_coordinate_descent(
+        coordinates={"global": fixed, "per_user": user_re},
+        update_sequence=["global", "per_user"],
+        n_iterations=3,
+        validator=lambda total: float(auc(total, labels)),
+    )
+    auc_game = result.validation_history[-1]
+    assert auc_game > auc_fixed + 0.01, (
+        f"GAME {auc_game:.4f} must beat fixed-only {auc_fixed:.4f}"
+    )
+    # Validation must not degrade over CD iterations.
+    assert result.validation_history[-1] >= result.validation_history[0] - 1e-3
+
+
+def test_coordinate_descent_converges_scores(rng):
+    """Total scores stabilize across iterations (residual passing works)."""
+    data = make_movielens_like(n_users=80, n_items=1, n_obs=3000, seed=23)
+    fixed, user_re = _movielens_coordinates(data)
+    res = run_coordinate_descent(
+        coordinates={"global": fixed, "per_user": user_re},
+        update_sequence=["global", "per_user"],
+        n_iterations=4,
+    )
+    # Re-run one more sweep: coefficients should barely move.
+    fixed_coefs = res.coefficients["global"]
+    offsets = res.total_scores - res.scores["global"]
+    w2, _ = fixed.train(offsets, fixed_coefs)
+    np.testing.assert_allclose(np.asarray(w2), np.asarray(fixed_coefs),
+                               atol=5e-3)
+
+
+def test_locked_coordinate_not_retrained(rng):
+    data = make_movielens_like(n_users=50, n_items=1, n_obs=2000, seed=31)
+    fixed, user_re = _movielens_coordinates(data)
+    w_fixed, _ = fixed.train(jnp.zeros(len(data["labels"]), jnp.float32))
+    res = run_coordinate_descent(
+        coordinates={"global": fixed, "per_user": user_re},
+        update_sequence=["global", "per_user"],
+        n_iterations=2,
+        locked_coordinates={"global": w_fixed},
+    )
+    np.testing.assert_array_equal(np.asarray(res.coefficients["global"]),
+                                  np.asarray(w_fixed))
+    assert "per_user" in res.coefficients
