@@ -13,6 +13,15 @@ from photon_ml_tpu.game.coordinates import (
     FixedEffectCoordinate,
     RandomEffectCoordinate,
     build_random_effect_coordinate,
+    build_random_effect_coordinate_sparse,
+)
+from photon_ml_tpu.game.projector import (
+    SubspaceProjection,
+    build_subspace_projection,
+)
+from photon_ml_tpu.game.sampling import (
+    binary_classification_down_sample,
+    default_down_sample,
 )
 from photon_ml_tpu.game.dataset import (
     EntityGrouping,
@@ -29,6 +38,11 @@ __all__ = [
     "FixedEffectCoordinate",
     "RandomEffectCoordinate",
     "build_random_effect_coordinate",
+    "build_random_effect_coordinate_sparse",
+    "SubspaceProjection",
+    "build_subspace_projection",
+    "binary_classification_down_sample",
+    "default_down_sample",
     "EntityGrouping",
     "GameDataset",
     "gather_from_blocks",

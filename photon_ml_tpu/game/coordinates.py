@@ -126,7 +126,8 @@ class RandomEffectCoordinate(Coordinate):
     name: str
     grouping: EntityGrouping
     # Per-bucket device arrays (built by ``build_random_effect_coordinate``):
-    x_blocks: list[Array]        # [E_b, cap_b, d_re]
+    # widths may differ per bucket when a subspace projection is applied.
+    x_blocks: list[Array]        # [E_b, cap_b, p_b]
     label_blocks: list[Array]    # [E_b, cap_b]
     weight_blocks: list[Array]   # [E_b, cap_b]
     mask_blocks: list[Array]     # [E_b, cap_b]
@@ -134,19 +135,14 @@ class RandomEffectCoordinate(Coordinate):
     ex_idx: list[Array]          # [n_b] example positions in this bucket
     row_idx: list[Array]         # [n_b] entity slot
     col_idx: list[Array]         # [n_b] within-entity position
-    # Per-example gather map for scoring:
-    x_re: Array                  # [n, d_re] per-example RE features
-    example_entity: Array        # [n] global entity index per example
-    bucket_global_idx: list[Array]  # per bucket: [E_b] global entity idx
+    n_examples: int
     problem: OptimizationProblem
-
-    @property
-    def dim(self) -> int:
-        return self.x_blocks[0].shape[-1]
+    # Set when features were subspace-projected (sparse global shard):
+    projection: "SubspaceProjection | None" = None
 
     def initial_coefficients(self) -> list[Array]:
         return [
-            jnp.zeros((blk.shape[0], self.dim), jnp.float32)
+            jnp.zeros((blk.shape[0], blk.shape[-1]), jnp.float32)
             for blk in self.x_blocks
         ]
 
@@ -175,18 +171,22 @@ class RandomEffectCoordinate(Coordinate):
 
     @partial(jax.jit, static_argnums=0)
     def score(self, coefficient_blocks: list[Array]) -> Array:
-        w_all = jnp.zeros((self.grouping.n_total_entities, self.dim),
-                          jnp.float32)
-        for b, blk in enumerate(coefficient_blocks):
-            w_all = w_all.at[self.bucket_global_idx[b]].set(blk)
-        w_per_example = w_all[self.example_entity]          # [n, d_re]
-        return jnp.sum(self.x_re * w_per_example, axis=-1)  # [n]
+        """Block-space scoring: x·w per entity block, gathered back to
+        example order (works for projected and unprojected widths)."""
+        scores = jnp.zeros((self.n_examples,), jnp.float32)
+        for b, w_b in enumerate(coefficient_blocks):
+            blk_scores = jnp.einsum("ecp,ep->ec", self.x_blocks[b], w_b)
+            scores = scores.at[self.ex_idx[b]].set(
+                blk_scores[self.row_idx[b], self.col_idx[b]]
+            )
+        return scores
 
     def as_model(self, coefficient_blocks: list[Array]) -> RandomEffectModel:
         return RandomEffectModel(
             coefficient_blocks=coefficient_blocks,
             grouping=self.grouping,
             feature_shard=self.name,
+            projection=self.projection,
         )
 
 
@@ -210,37 +210,18 @@ def build_random_effect_coordinate(
     labels = dataset.labels.astype(np.float32)
     weights = dataset.weight_array()
 
-    x_blocks, lab_blocks, wt_blocks, mask_blocks = [], [], [], []
-    ex_idx, row_idx, col_idx, bucket_gidx = [], [], [], []
+    lab_blocks, wt_blocks, mask_blocks = _scalar_blocks(
+        grouping, labels, weights
+    )
+    ex_idx, row_idx, col_idx = _index_maps(grouping)
+
+    x_blocks = []
     for b, (cap, ne) in enumerate(zip(grouping.capacities,
                                       grouping.n_entities)):
         sel = np.where(grouping.example_bucket == b)[0]
-        rows = grouping.example_row[sel]
-        cols = grouping.example_col[sel]
         xb = np.zeros((ne, cap, x.shape[1]), np.float32)
-        lb = np.zeros((ne, cap), np.float32)
-        wb = np.zeros((ne, cap), np.float32)
-        mb = np.zeros((ne, cap), np.float32)
-        xb[rows, cols] = x[sel]
-        lb[rows, cols] = labels[sel]
-        wb[rows, cols] = weights[sel]
-        mb[rows, cols] = 1.0
+        xb[grouping.example_row[sel], grouping.example_col[sel]] = x[sel]
         x_blocks.append(jnp.asarray(xb))
-        lab_blocks.append(jnp.asarray(lb))
-        wt_blocks.append(jnp.asarray(wb))
-        mask_blocks.append(jnp.asarray(mb))
-        ex_idx.append(jnp.asarray(sel.astype(np.int32)))
-        row_idx.append(jnp.asarray(rows.astype(np.int32)))
-        col_idx.append(jnp.asarray(cols.astype(np.int32)))
-        bucket_gidx.append(jnp.asarray(
-            np.where(grouping.entity_bucket == b)[0].astype(np.int32)
-        ))
-
-    # Global entity index per example (unique-id order).
-    uniq_pos = {int(e): i for i, e in enumerate(grouping.entity_ids)}
-    example_entity = np.asarray(
-        [uniq_pos[int(e)] for e in entity_ids], np.int32
-    )
 
     problem = OptimizationProblem(
         objective=objective,
@@ -257,8 +238,88 @@ def build_random_effect_coordinate(
         ex_idx=ex_idx,
         row_idx=row_idx,
         col_idx=col_idx,
-        x_re=jnp.asarray(x),
-        example_entity=jnp.asarray(example_entity),
-        bucket_global_idx=bucket_gidx,
+        n_examples=len(labels),
         problem=problem,
+    )
+
+
+def _scalar_blocks(grouping, labels, weights):
+    """labels/weights/mask → per-bucket [E_b, cap_b] blocks."""
+    lab_blocks, wt_blocks, mask_blocks = [], [], []
+    for b, (cap, ne) in enumerate(zip(grouping.capacities,
+                                      grouping.n_entities)):
+        sel = np.where(grouping.example_bucket == b)[0]
+        rows = grouping.example_row[sel]
+        cols = grouping.example_col[sel]
+        lb = np.zeros((ne, cap), np.float32)
+        wb = np.zeros((ne, cap), np.float32)
+        mb = np.zeros((ne, cap), np.float32)
+        lb[rows, cols] = labels[sel]
+        wb[rows, cols] = weights[sel]
+        mb[rows, cols] = 1.0
+        lab_blocks.append(jnp.asarray(lb))
+        wt_blocks.append(jnp.asarray(wb))
+        mask_blocks.append(jnp.asarray(mb))
+    return lab_blocks, wt_blocks, mask_blocks
+
+
+def _index_maps(grouping):
+    ex_idx, row_idx, col_idx = [], [], []
+    for b in range(len(grouping.capacities)):
+        sel = np.where(grouping.example_bucket == b)[0]
+        ex_idx.append(jnp.asarray(sel.astype(np.int32)))
+        row_idx.append(jnp.asarray(grouping.example_row[sel].astype(np.int32)))
+        col_idx.append(jnp.asarray(grouping.example_col[sel].astype(np.int32)))
+    return ex_idx, row_idx, col_idx
+
+
+def build_random_effect_coordinate_sparse(
+    name: str,
+    dataset: GameDataset,
+    feature_shard: str,
+    objective: GLMObjective,
+    global_dim: int,
+    config: OptimizerConfig | None = None,
+    optimizer=None,
+    bucket_base: int = 4,
+) -> RandomEffectCoordinate:
+    """Sparse-shard variant: features arrive as per-example (col_ids,
+    values) rows in a wide global space; each entity's problem is solved
+    in its observed-feature subspace (reference
+    ``LinearSubspaceProjector`` path, SURVEY §2.4)."""
+    from photon_ml_tpu.game.projector import build_subspace_projection
+    from photon_ml_tpu.optim.base import OptimizerType
+
+    rows = dataset.features[feature_shard]
+    entity_ids = dataset.entity_ids[name]
+    grouping = group_by_entity(entity_ids, bucket_base=bucket_base)
+
+    projection, x_blocks_np = build_subspace_projection(
+        grouping, rows, global_dim
+    )
+    labels = dataset.labels.astype(np.float32)
+    weights = dataset.weight_array()
+    lab_blocks, wt_blocks, mask_blocks = _scalar_blocks(
+        grouping, labels, weights
+    )
+    ex_idx, row_idx, col_idx = _index_maps(grouping)
+
+    problem = OptimizationProblem(
+        objective=objective,
+        optimizer=optimizer or OptimizerType.LBFGS,
+        config=config or OptimizerConfig(),
+    )
+    return RandomEffectCoordinate(
+        name=name,
+        grouping=grouping,
+        x_blocks=[jnp.asarray(xb) for xb in x_blocks_np],
+        label_blocks=lab_blocks,
+        weight_blocks=wt_blocks,
+        mask_blocks=mask_blocks,
+        ex_idx=ex_idx,
+        row_idx=row_idx,
+        col_idx=col_idx,
+        n_examples=len(labels),
+        problem=problem,
+        projection=projection,
     )

@@ -1,0 +1,127 @@
+"""Per-entity linear subspace projection for sparse random-effect shards.
+
+Reference counterpart: ``LinearSubspaceProjector`` / ``ProjectorType``
+(photon-api ``com.linkedin.photon.ml.projector`` [expected paths, mount
+unavailable — see SURVEY.md §2.4]).
+
+Purpose (same as the reference): a random-effect feature shard may be
+wide (10⁴⁺ features), but each entity only ever sees a few dozen of
+them — so each entity's local problem is solved in the subspace of
+features it actually observed, making per-entity coefficient vectors
+tiny and vmapped solves dense.
+
+TPU translation: projection happens ONCE, in the host ETL.  For each
+entity, the distinct global feature ids it saw become its subspace
+(``feature_ids [E, p]``, padded); its examples' sparse entries are
+remapped to local column indices and densified into [cap, p] blocks.
+Device-side training never sees the global width.  ``project_back``
+scatters learned local coefficients into global-width rows for model
+export/scoring against new data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from photon_ml_tpu.game.dataset import EntityGrouping
+
+
+@dataclasses.dataclass
+class SubspaceProjection:
+    """Per-bucket per-entity subspaces for one random-effect shard.
+
+    ``feature_ids[b]`` is [E_b, p_b] int32: global feature id of each
+    local column (−1 padding).  ``local_dim[b]`` = p_b.
+    """
+
+    feature_ids: list[np.ndarray]
+    global_dim: int
+
+    def local_dim(self, bucket: int) -> int:
+        return self.feature_ids[bucket].shape[1]
+
+    def project_back(self, bucket: int, w_local: np.ndarray) -> list[
+            tuple[np.ndarray, np.ndarray]]:
+        """[E_b, p_b] local coefficients → per-entity sparse global rows
+        (col_ids, values) — the reference's model-export direction."""
+        fids = self.feature_ids[bucket]
+        out = []
+        for e in range(fids.shape[0]):
+            valid = fids[e] >= 0
+            out.append((fids[e][valid], np.asarray(w_local[e])[valid]))
+        return out
+
+
+def build_subspace_projection(
+    grouping: EntityGrouping,
+    rows: list[tuple[np.ndarray, np.ndarray]],
+    global_dim: int,
+) -> tuple[SubspaceProjection, list[np.ndarray]]:
+    """Build per-entity subspaces + projected dense feature blocks.
+
+    Args:
+      grouping: entity grouping of the n examples.
+      rows: per-example sparse (col_ids, values) in the GLOBAL space.
+      global_dim: width of the global space.
+
+    Returns:
+      (projection, x_blocks) where ``x_blocks[b]`` is a dense
+      [E_b, cap_b, p_b] array of projected features.
+    """
+    n_buckets = len(grouping.capacities)
+    # Distinct features per entity.
+    entity_feats: list[np.ndarray] = []
+    for e in range(grouping.n_total_entities):
+        entity_feats.append(np.empty(0, np.int64))
+    feats_accum: dict[int, set] = {}
+    uniq_pos = {int(v): i for i, v in enumerate(grouping.entity_ids)}
+
+    # Map each example to its global entity index via (bucket, row).
+    slot_to_entity = {}
+    for e in range(grouping.n_total_entities):
+        slot_to_entity[(int(grouping.entity_bucket[e]),
+                        int(grouping.entity_slot[e]))] = e
+
+    ex_entity = np.empty(grouping.n_examples, np.int64)
+    for i in range(grouping.n_examples):
+        ex_entity[i] = slot_to_entity[(int(grouping.example_bucket[i]),
+                                       int(grouping.example_row[i]))]
+
+    for i, (c, _) in enumerate(rows):
+        s = feats_accum.setdefault(int(ex_entity[i]), set())
+        s.update(int(x) for x in c)
+
+    for e, s in feats_accum.items():
+        entity_feats[e] = np.asarray(sorted(s), np.int64)
+
+    # Per-bucket local width = max distinct features among its entities.
+    feature_ids = []
+    x_blocks = []
+    for b in range(n_buckets):
+        members = np.where(grouping.entity_bucket == b)[0]
+        p = max((len(entity_feats[e]) for e in members), default=1)
+        p = max(p, 1)
+        fids = np.full((len(members), p), -1, np.int32)
+        local_index: list[dict] = []
+        for s_i, e in enumerate(members):
+            f = entity_feats[e]
+            fids[s_i, : len(f)] = f
+            local_index.append({int(g): j for j, g in enumerate(f)})
+        feature_ids.append(fids)
+
+        cap = grouping.capacities[b]
+        xb = np.zeros((len(members), cap, p), np.float32)
+        sel = np.where(grouping.example_bucket == b)[0]
+        for i in sel:
+            r = grouping.example_row[i]
+            col = grouping.example_col[i]
+            li = local_index[r]
+            c, v = rows[i]
+            for g, val in zip(c, v):
+                xb[r, col, li[int(g)]] = val
+        x_blocks.append(xb)
+
+    return SubspaceProjection(feature_ids=feature_ids,
+                              global_dim=global_dim), x_blocks

@@ -27,6 +27,7 @@ from photon_ml_tpu.models.coefficients import Coefficients
 
 if TYPE_CHECKING:  # import would cycle through the game package at runtime
     from photon_ml_tpu.game.dataset import EntityGrouping
+    from photon_ml_tpu.game.projector import SubspaceProjection
 
 Array = jax.Array
 
@@ -47,37 +48,60 @@ class FixedEffectModel:
 class RandomEffectModel:
     """Per-entity coefficients, stored as size-bucketed blocks.
 
-    ``coefficient_blocks[b]`` is [E_b, d_re] for bucket b of the
+    ``coefficient_blocks[b]`` is [E_b, p_b] for bucket b of the
     grouping; ``grouping`` maps original entity ids to (bucket, slot).
-    Entities never seen in training score zero (the reference's behavior
-    for missing REIds: only the fixed effect + other coordinates apply).
+    When the coordinate used a subspace projection, ``projection``
+    carries each entity's local→global feature map and p_b varies per
+    bucket.  Entities never seen in training score zero (the reference's
+    behavior for missing REIds: only the other coordinates apply).
     """
 
     coefficient_blocks: list[Array]
     grouping: EntityGrouping
     feature_shard: str
     variance_blocks: list[Array] | None = None
-
-    @property
-    def dim(self) -> int:
-        return self.coefficient_blocks[0].shape[-1]
+    projection: "SubspaceProjection | None" = None
 
     @property
     def n_entities(self) -> int:
         return self.grouping.n_total_entities
 
     def coefficients_for(self, entity_id) -> np.ndarray | None:
-        """Host-side per-entity lookup (model inspection / serialization)."""
+        """Host-side per-entity lookup, in the entity's LOCAL space
+        (model inspection / serialization)."""
         idx = self.grouping.entity_index().get(int(entity_id))
         if idx is None:
             return None
         b, s = idx
         return np.asarray(self.coefficient_blocks[b][s])
 
+    def global_coefficients_for(self, entity_id) -> np.ndarray | None:
+        """Per-entity coefficients scattered into the global feature
+        space (projection inverted; identity when unprojected)."""
+        idx = self.grouping.entity_index().get(int(entity_id))
+        if idx is None:
+            return None
+        b, s = idx
+        local = np.asarray(self.coefficient_blocks[b][s])
+        if self.projection is None:
+            return local
+        fids = self.projection.feature_ids[b][s]
+        out = np.zeros(self.projection.global_dim, local.dtype)
+        valid = fids >= 0
+        out[fids[valid]] = local[valid]
+        return out
+
     def all_coefficients(self) -> Array:
         """[E_total, d_re] in global entity order (unique-id sorted) —
-        the gatherable form scoring uses."""
-        out = jnp.zeros((self.n_entities, self.dim),
+        the gatherable form scoring uses.  Unprojected models only (all
+        buckets share one width)."""
+        if self.projection is not None:
+            raise ValueError(
+                "all_coefficients is width-uniform; use "
+                "global_coefficients_for on projected models"
+            )
+        dim = self.coefficient_blocks[0].shape[-1]
+        out = jnp.zeros((self.n_entities, dim),
                         self.coefficient_blocks[0].dtype)
         for b, blk in enumerate(self.coefficient_blocks):
             global_idx = np.where(self.grouping.entity_bucket == b)[0]

@@ -218,3 +218,128 @@ def test_locked_coordinate_not_retrained(rng):
     np.testing.assert_array_equal(np.asarray(res.coefficients["global"]),
                                   np.asarray(w_fixed))
     assert "per_user" in res.coefficients
+
+
+# ---------------------------------------------------------------------------
+# Projector, down-sampling, two-RE GAME (config-5 shape)
+# ---------------------------------------------------------------------------
+
+def test_subspace_projection_round_trip(rng):
+    from photon_ml_tpu.game import build_subspace_projection
+
+    n, global_dim = 200, 500
+    ids = rng.integers(0, 20, n)
+    rows = []
+    for i in range(n):
+        k = rng.integers(2, 6)
+        c = np.sort(rng.choice(global_dim, k, replace=False)).astype(np.int32)
+        rows.append((c, rng.normal(0, 1, k).astype(np.float32)))
+    g = group_by_entity(ids)
+    proj, x_blocks = build_subspace_projection(g, rows, global_dim)
+
+    # Every example's features must appear, remapped, in its block row.
+    for i in rng.choice(n, 30, replace=False):
+        b = g.example_bucket[i]
+        r, c_pos = g.example_row[i], g.example_col[i]
+        dense_local = x_blocks[b][r, c_pos]
+        fids = proj.feature_ids[b][r]
+        c, v = rows[i]
+        rebuilt = np.zeros(global_dim, np.float32)
+        valid = fids >= 0
+        rebuilt[fids[valid]] = dense_local[: valid.sum()]
+        expect = np.zeros(global_dim, np.float32)
+        expect[c] = v
+        np.testing.assert_allclose(rebuilt, expect, atol=1e-6)
+    # Local widths are bounded by entities' distinct-feature counts.
+    for b, fids in enumerate(proj.feature_ids):
+        assert fids.shape[1] <= global_dim
+
+
+def test_sparse_re_coordinate_matches_dense(rng):
+    """Projected sparse RE solve == dense RE solve on equivalent data."""
+    from photon_ml_tpu.game import build_random_effect_coordinate_sparse
+
+    n, d_re = 300, 6
+    ids = rng.integers(0, 15, n)
+    x = rng.normal(0, 1, (n, d_re)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+
+    # Sparse view of the same dense data (all features explicit).
+    rows = [(np.arange(d_re, dtype=np.int32), x[i]) for i in range(n)]
+
+    ds_dense = GameDataset(labels=y, features={"re": x}, entity_ids={"u": ids})
+    ds_sparse = GameDataset(labels=y, features={"re": rows},
+                            entity_ids={"u": ids})
+    cfg = OptimizerConfig(max_iters=50, tolerance=1e-6, track_states=False)
+    dense_c = build_random_effect_coordinate(
+        "u", ds_dense, "re", _re_objective(), config=cfg)
+    sparse_c = build_random_effect_coordinate_sparse(
+        "u", ds_sparse, "re", _re_objective(), global_dim=d_re, config=cfg)
+
+    off = jnp.zeros(n, jnp.float32)
+    dense_blocks, _ = dense_c.train(off)
+    sparse_blocks, _ = sparse_c.train(off)
+    np.testing.assert_allclose(
+        np.asarray(dense_c.score(dense_blocks)),
+        np.asarray(sparse_c.score(sparse_blocks)),
+        atol=2e-3,
+    )
+    # Global-space per-entity coefficients agree.
+    dm = dense_c.as_model(dense_blocks)
+    sm = sparse_c.as_model(sparse_blocks)
+    for e in np.unique(ids)[:5]:
+        np.testing.assert_allclose(
+            sm.global_coefficients_for(e), dm.coefficients_for(e), atol=2e-3
+        )
+
+
+def test_binary_down_sampling_preserves_objective_scale(rng):
+    from photon_ml_tpu.game import binary_classification_down_sample
+
+    n = 20000
+    labels = (rng.uniform(size=n) < 0.1).astype(np.float32)
+    weights = np.ones(n, np.float32)
+    idx, new_w = binary_classification_down_sample(labels, weights, 0.25,
+                                                   seed=1)
+    # All positives kept.
+    assert set(np.where(labels > 0.5)[0]) <= set(idx)
+    # Total negative weight approximately preserved (unbiasedness).
+    neg_before = float((1 - labels).sum())
+    kept_labels = labels[idx]
+    neg_after = float(new_w[kept_labels < 0.5].sum())
+    assert abs(neg_after - neg_before) / neg_before < 0.05
+
+
+def test_two_random_effects_config5_shape(rng):
+    """BASELINE config-5 shape: fixed + per-user + per-item effects."""
+    data = make_movielens_like(n_users=80, n_items=40, n_obs=6000, seed=41)
+    labels = jnp.asarray(data["labels"])
+    n = len(data["labels"])
+    fixed, user_re = _movielens_coordinates(data)
+    ds_items = GameDataset(
+        labels=data["labels"],
+        features={"item_re": np.ones((n, 1), np.float32)},
+        entity_ids={"per_item": data["item_ids"]},
+    )
+    item_re = build_random_effect_coordinate(
+        "per_item", ds_items, "item_re", _re_objective(l2=2.0),
+        config=OptimizerConfig(max_iters=50, tolerance=1e-6,
+                               track_states=False),
+    )
+
+    res_1re = run_coordinate_descent(
+        coordinates={"global": fixed, "per_user": user_re},
+        update_sequence=["global", "per_user"],
+        n_iterations=2,
+        validator=lambda t: float(auc(t, labels)),
+    )
+    res_2re = run_coordinate_descent(
+        coordinates={"global": fixed, "per_user": user_re,
+                     "per_item": item_re},
+        update_sequence=["global", "per_user", "per_item"],
+        n_iterations=2,
+        validator=lambda t: float(auc(t, labels)),
+    )
+    assert res_2re.validation_history[-1] > res_1re.validation_history[-1], (
+        "adding the item effect must improve fit on item-effect data"
+    )
