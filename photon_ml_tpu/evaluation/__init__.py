@@ -4,6 +4,10 @@ Reference: photon-api ``com.linkedin.photon.ml.evaluation`` (SURVEY.md
 §2.6 — expected paths, mount unavailable).
 """
 
+from photon_ml_tpu.evaluation.sharded import (
+    sharded_auc,
+    sharded_precision_at_k,
+)
 from photon_ml_tpu.evaluation.evaluators import (
     EvaluatorType,
     auc,
@@ -24,4 +28,6 @@ __all__ = [
     "poisson_loss",
     "rmse",
     "squared_loss",
+    "sharded_auc",
+    "sharded_precision_at_k",
 ]

@@ -86,3 +86,35 @@ def test_evaluate_dispatch_and_ordering(rng):
     assert 0.0 <= float(a) <= 1.0
     assert bool(better_than(EvaluatorType.AUC, 0.9, 0.8))
     assert bool(better_than(EvaluatorType.RMSE, 0.8, 0.9))
+
+
+def test_sharded_auc_matches_manual_average(rng):
+    from photon_ml_tpu.evaluation import sharded_auc
+
+    n = 600
+    ids = rng.integers(0, 12, n)
+    scores = rng.normal(0, 1, n)
+    labels = (rng.uniform(size=n) < 0.5).astype(np.float64)
+    got = sharded_auc(scores, labels, ids)
+    vals = []
+    for e in np.unique(ids):
+        m = ids == e
+        if len(np.unique(labels[m])) == 2:
+            vals.append(sklearn.metrics.roc_auc_score(labels[m], scores[m]))
+    np.testing.assert_allclose(got, np.mean(vals), rtol=1e-6)
+
+
+def test_sharded_precision_at_k_matches_manual(rng):
+    from photon_ml_tpu.evaluation import sharded_precision_at_k
+
+    n, k = 400, 5
+    ids = rng.integers(0, 20, n)
+    scores = rng.normal(0, 1, n)
+    labels = (rng.uniform(size=n) < 0.3).astype(np.float64)
+    got = sharded_precision_at_k(scores, labels, ids, k)
+    vals = []
+    for e in np.unique(ids):
+        m = np.where(ids == e)[0]
+        top = m[np.argsort(-scores[m])][:k]
+        vals.append(labels[top].mean())
+    np.testing.assert_allclose(got, np.mean(vals), rtol=1e-6)
