@@ -1,0 +1,238 @@
+"""Typed configuration: the rebuild of the reference's Param plumbing.
+
+Reference counterparts: the spark.ml ``Param``/``ParamMap`` objects +
+Scopt CLI parsers on each driver (``GameTrainingDriver`` ~40 params,
+``ScoptGameTrainingParametersParser``, coordinate-configuration strings
+— photon-client ``com.linkedin.photon.ml.cli.game`` [expected paths,
+mount unavailable — see SURVEY.md §2.8/§5.6]).
+
+Design: one validated dataclass per concern, JSON in/out (the reference
+passes coordinate configs as structured CLI strings; JSON is the honest
+modern equivalent).  Validation happens in ``__post_init__``/
+``validate`` — the reference's ``ParamValidators`` role.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from typing import Any
+
+from photon_ml_tpu.data.normalization import NormalizationType
+from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+from photon_ml_tpu.models.glm import TaskType
+from photon_ml_tpu.ops.regularization import RegularizationType
+from photon_ml_tpu.optim.base import OptimizerType
+
+
+class CoordinateKind(str, enum.Enum):
+    FIXED_EFFECT = "FIXED_EFFECT"
+    RANDOM_EFFECT = "RANDOM_EFFECT"
+
+
+@dataclasses.dataclass
+class OptimizerSettings:
+    """Per-coordinate optimizer configuration (reference
+    ``FixedEffectOptimizationConfiguration`` /
+    ``RandomEffectOptimizationConfiguration``)."""
+
+    optimizer: OptimizerType = OptimizerType.LBFGS
+    max_iters: int = 100
+    tolerance: float = 1e-6
+    regularization: RegularizationType = RegularizationType.L2
+    reg_weight: float = 1.0
+    elastic_net_alpha: float = 0.5  # only for ELASTIC_NET
+
+    def validate(self) -> None:
+        if self.max_iters <= 0:
+            raise ValueError("max_iters must be positive")
+        if self.tolerance <= 0:
+            raise ValueError("tolerance must be positive")
+        if self.reg_weight < 0:
+            raise ValueError("reg_weight must be non-negative")
+        if not 0.0 <= self.elastic_net_alpha <= 1.0:
+            raise ValueError("elastic_net_alpha must be in [0, 1]")
+        if (self.optimizer == OptimizerType.TRON
+                and self.regularization in (RegularizationType.L1,
+                                            RegularizationType.ELASTIC_NET)):
+            raise ValueError("TRON cannot handle L1/elastic-net; use LBFGS")
+
+
+@dataclasses.dataclass
+class CoordinateConfig:
+    """One GAME coordinate (reference coordinate-configuration params)."""
+
+    name: str
+    kind: CoordinateKind
+    feature_shard: str
+    entity_key: str | None = None          # RANDOM_EFFECT only
+    optimizer: OptimizerSettings = dataclasses.field(
+        default_factory=OptimizerSettings
+    )
+    down_sampling_rate: float | None = None  # FIXED_EFFECT only
+
+    def validate(self) -> None:
+        self.optimizer.validate()
+        if self.kind == CoordinateKind.RANDOM_EFFECT and not self.entity_key:
+            raise ValueError(
+                f"random-effect coordinate '{self.name}' needs entity_key"
+            )
+        if self.down_sampling_rate is not None:
+            if self.kind != CoordinateKind.FIXED_EFFECT:
+                raise ValueError("down-sampling applies to fixed effects")
+            if not 0.0 < self.down_sampling_rate <= 1.0:
+                raise ValueError("down_sampling_rate must be in (0, 1]")
+
+
+@dataclasses.dataclass
+class TrainingConfig:
+    """Full training-run configuration (reference ``GameTrainingDriver``
+    params; SURVEY §2.8)."""
+
+    task_type: TaskType
+    coordinates: list[CoordinateConfig]
+    update_sequence: list[str]
+    input_path: str = ""
+    validation_path: str | None = None
+    validation_fraction: float = 0.0       # split from input if no file
+    output_dir: str = "output"
+    n_iterations: int = 1
+    normalization: NormalizationType = NormalizationType.NONE
+    evaluators: list[EvaluatorType] = dataclasses.field(
+        default_factory=lambda: [EvaluatorType.AUC]
+    )
+    # Hyperparameter grid: per-coordinate reg-weight lists, cartesian over
+    # coordinates (reference GameOptimizationConfiguration grid).
+    reg_weight_grid: dict[str, list[float]] = dataclasses.field(
+        default_factory=dict
+    )
+    model_output_mode: str = "BEST"        # ALL | BEST | EXPLICIT
+    warm_start_model_dir: str | None = None
+    locked_coordinates: list[str] = dataclasses.field(default_factory=list)
+    intercept: bool = True
+    seed: int = 0
+
+    def validate(self) -> None:
+        names = [c.name for c in self.coordinates]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate coordinate names")
+        for c in self.coordinates:
+            c.validate()
+        for s in self.update_sequence:
+            if s not in names:
+                raise ValueError(f"update_sequence entry '{s}' unknown")
+        for s in self.locked_coordinates:
+            if s not in names:
+                raise ValueError(f"locked coordinate '{s}' unknown")
+        if not 0.0 <= self.validation_fraction < 1.0:
+            raise ValueError("validation_fraction must be in [0, 1)")
+        if self.n_iterations <= 0:
+            raise ValueError("n_iterations must be positive")
+        if self.model_output_mode not in ("ALL", "BEST", "EXPLICIT"):
+            raise ValueError("model_output_mode must be ALL|BEST|EXPLICIT")
+        for name, grid in self.reg_weight_grid.items():
+            if name not in names:
+                raise ValueError(f"grid entry '{name}' unknown")
+            if not grid:
+                raise ValueError(f"empty grid for '{name}'")
+
+
+@dataclasses.dataclass
+class ScoringConfig:
+    """Scoring-run configuration (reference ``GameScoringDriver``)."""
+
+    input_path: str
+    model_dir: str
+    output_path: str = "scores.npz"
+    evaluators: list[EvaluatorType] = dataclasses.field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# JSON (de)serialization.  Enums serialize by value; nested dataclasses by
+# field name — forgiving on input (unknown keys rejected, enums by name or
+# value).
+# ---------------------------------------------------------------------------
+
+def _to_jsonable(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _to_jsonable(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, dict):
+        return {k: _to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(v) for v in obj]
+    return obj
+
+
+def config_to_json(config) -> str:
+    return json.dumps(_to_jsonable(config), indent=2)
+
+
+def _build(cls, data: Any):
+    if dataclasses.is_dataclass(cls) and isinstance(data, dict):
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        unknown = set(data) - set(fields)
+        if unknown:
+            raise ValueError(f"unknown config keys for {cls.__name__}: "
+                             f"{sorted(unknown)}")
+        kwargs = {}
+        for k, v in data.items():
+            kwargs[k] = _coerce(fields[k].type, v)
+        return cls(**kwargs)
+    return data
+
+
+_ENUMS = {
+    "TaskType": TaskType,
+    "CoordinateKind": CoordinateKind,
+    "OptimizerType": OptimizerType,
+    "RegularizationType": RegularizationType,
+    "NormalizationType": NormalizationType,
+    "EvaluatorType": EvaluatorType,
+}
+
+
+def _coerce(type_str, v):
+    """Best-effort typed coercion from annotation strings (PEP 563)."""
+    t = type_str if isinstance(type_str, str) else getattr(
+        type_str, "__name__", str(type_str))
+    if isinstance(v, list):
+        if "CoordinateConfig" in t:
+            return [_build(CoordinateConfig, c) for c in v]
+        for name, enum_cls in _ENUMS.items():
+            if name in t:
+                return [enum_cls(e) if isinstance(e, str) else e for e in v]
+        return v
+    if isinstance(v, str):
+        for name, enum_cls in _ENUMS.items():
+            if name in t:
+                try:
+                    return enum_cls(v)
+                except ValueError:
+                    return enum_cls[v]
+    if "OptimizerSettings" in t and isinstance(v, dict):
+        return _build(OptimizerSettings, v)
+    return v
+
+
+def training_config_from_json(text: str) -> TrainingConfig:
+    cfg = _build(TrainingConfig, json.loads(text))
+    cfg.validate()
+    return cfg
+
+
+def scoring_config_from_json(text: str) -> ScoringConfig:
+    return _build(ScoringConfig, json.loads(text))
+
+
+def load_training_config(path: str) -> TrainingConfig:
+    with open(path) as f:
+        return training_config_from_json(f.read())
+
+
+def load_scoring_config(path: str) -> ScoringConfig:
+    with open(path) as f:
+        return scoring_config_from_json(f.read())

@@ -1,0 +1,8 @@
+"""Estimator/transformer API (reference ``GameEstimator`` /
+``GameTransformer``, SURVEY.md §2.6 — expected paths, mount unavailable).
+"""
+
+from photon_ml_tpu.estimators.game_estimator import FitResult, GameEstimator
+from photon_ml_tpu.estimators.game_transformer import GameTransformer
+
+__all__ = ["FitResult", "GameEstimator", "GameTransformer"]

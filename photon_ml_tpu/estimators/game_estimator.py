@@ -1,0 +1,323 @@
+"""GameEstimator: configs + data → trained, evaluated GAME models.
+
+Reference counterpart: ``GameEstimator``
+(photon-api ``com.linkedin.photon.ml.estimators.GameEstimator``
+[expected path, mount unavailable — see SURVEY.md §2.6/§3.1]): build
+datasets/coordinates from configuration, run coordinate descent once per
+optimization configuration in the hyperparameter grid, evaluate each on
+validation, return (model, evaluations, config) triples.
+
+TPU translation notes:
+
+- dataset/coordinate construction is the host ETL (entity grouping,
+  intercept column, normalization stats, down-sampling), done ONCE and
+  reused across the λ grid — only objectives change per grid point
+  (the reference likewise persists datasets across the grid);
+- per-iteration validation uses the trained-so-far model via
+  ``GameTransformer`` on the validation set;
+- normalization with shifts folds the margin correction into the
+  intercept coefficient at export, so saved models score raw features
+  directly (see ``_export_fixed``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.config import (
+    CoordinateConfig,
+    CoordinateKind,
+    OptimizerSettings,
+    TrainingConfig,
+)
+from photon_ml_tpu.data.batch import make_dense_batch, make_sparse_batch
+from photon_ml_tpu.data.normalization import (
+    NormalizationContext,
+    NormalizationType,
+    compute_normalization,
+)
+from photon_ml_tpu.data.statistics import compute_statistics
+from photon_ml_tpu.estimators.game_transformer import GameTransformer
+from photon_ml_tpu.evaluation import evaluate, better_than
+from photon_ml_tpu.game.coordinates import (
+    FixedEffectCoordinate,
+    build_random_effect_coordinate,
+    build_random_effect_coordinate_sparse,
+)
+from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.game.sampling import binary_classification_down_sample
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel
+from photon_ml_tpu.models.glm import TaskType
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.regularization import (
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_ml_tpu.optim import OptimizationProblem, OptimizerConfig
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class FitResult:
+    """(model, evaluations, grid point) — the reference's result triple."""
+
+    model: GameModel
+    evaluations: dict            # EvaluatorType → float (validation)
+    reg_weights: dict            # coordinate name → λ used
+
+
+def _reg_context(settings: OptimizerSettings, weight: float, dim: int,
+                 intercept_index: int | None) -> RegularizationContext:
+    from photon_ml_tpu.ops.regularization import exclude_intercept_mask
+
+    mask = exclude_intercept_mask(dim, intercept_index)
+    if settings.regularization == RegularizationType.NONE or weight == 0.0:
+        return RegularizationContext.none()
+    if settings.regularization == RegularizationType.L2:
+        return RegularizationContext.l2(weight, mask)
+    if settings.regularization == RegularizationType.L1:
+        return RegularizationContext.l1(weight, mask)
+    return RegularizationContext.elastic_net(
+        weight, settings.elastic_net_alpha, mask
+    )
+
+
+def _optimizer_config(settings: OptimizerSettings) -> OptimizerConfig:
+    return OptimizerConfig(
+        max_iters=settings.max_iters,
+        tolerance=settings.tolerance,
+        track_states=False,
+    )
+
+
+class GameEstimator:
+    """Build coordinates once; fit once per λ-grid point."""
+
+    def __init__(self, config: TrainingConfig):
+        config.validate()
+        self.config = config
+        self.task = config.task_type
+        self.loss = self.task.loss
+
+    # -- dataset preparation (once) ----------------------------------------
+
+    def _prepare(self, train: GameDataset):
+        cfg = self.config
+        prep = {}
+        for coord_cfg in cfg.coordinates:
+            if coord_cfg.kind == CoordinateKind.FIXED_EFFECT:
+                prep[coord_cfg.name] = self._prepare_fixed(train, coord_cfg)
+        return prep
+
+    def _prepare_fixed(self, train: GameDataset, coord_cfg: CoordinateConfig):
+        cfg = self.config
+        feats = train.features[coord_cfg.feature_shard]
+        labels = train.labels.astype(np.float32)
+        weights = train.weight_array()
+
+        intercept_index = None
+        if isinstance(feats, np.ndarray):
+            x = np.asarray(feats, np.float32)
+            if cfg.intercept:
+                x = np.concatenate([x, np.ones((len(x), 1), np.float32)], 1)
+                intercept_index = x.shape[1] - 1
+            batch = make_dense_batch(x, labels, weights=weights)
+            dim = x.shape[1]
+        else:  # sparse rows
+            dim = train.feature_dim(coord_cfg.feature_shard)
+            rows = feats
+            if cfg.intercept:
+                rows = [
+                    (np.append(c, dim).astype(np.int32),
+                     np.append(v, 1.0).astype(np.float32))
+                    for c, v in rows
+                ]
+                intercept_index = dim
+                dim += 1
+            batch = make_sparse_batch(rows, dim, labels, weights=weights)
+
+        norm = NormalizationContext.identity()
+        if cfg.normalization != NormalizationType.NONE:
+            stats = compute_statistics(batch)
+            if (cfg.normalization == NormalizationType.STANDARDIZATION
+                    and intercept_index is None):
+                raise ValueError(
+                    "STANDARDIZATION requires intercept=True (the margin "
+                    "shift folds into the intercept at export)"
+                )
+            norm = compute_normalization(
+                stats.mean, stats.std, stats.max_abs, cfg.normalization,
+                intercept_index=intercept_index,
+            )
+
+        train_idx = train_weights = None
+        if coord_cfg.down_sampling_rate is not None:
+            idx, new_w = binary_classification_down_sample(
+                labels, weights, coord_cfg.down_sampling_rate, seed=cfg.seed
+            )
+            train_idx = jnp.asarray(idx.astype(np.int32))
+            train_weights = jnp.asarray(new_w)
+
+        return {
+            "batch": batch, "norm": norm, "dim": dim,
+            "intercept_index": intercept_index,
+            "train_idx": train_idx, "train_weights": train_weights,
+        }
+
+    # -- coordinate construction (per grid point) --------------------------
+
+    def _build_coordinates(self, train: GameDataset, prep: dict,
+                           reg_weights: dict):
+        cfg = self.config
+        coords = {}
+        for coord_cfg in cfg.coordinates:
+            weight = reg_weights.get(coord_cfg.name,
+                                     coord_cfg.optimizer.reg_weight)
+            ocfg = _optimizer_config(coord_cfg.optimizer)
+            if coord_cfg.kind == CoordinateKind.FIXED_EFFECT:
+                p = prep[coord_cfg.name]
+                objective = GLMObjective(
+                    loss=self.loss,
+                    reg=_reg_context(coord_cfg.optimizer, weight, p["dim"],
+                                     p["intercept_index"]),
+                    norm=p["norm"],
+                )
+                coords[coord_cfg.name] = FixedEffectCoordinate(
+                    name=coord_cfg.name,
+                    batch=p["batch"],
+                    problem=OptimizationProblem(
+                        objective=objective,
+                        optimizer=coord_cfg.optimizer.optimizer,
+                        config=ocfg,
+                    ),
+                    train_idx=p["train_idx"],
+                    train_weights=p["train_weights"],
+                )
+            else:
+                feats = train.features[coord_cfg.feature_shard]
+                objective = GLMObjective(
+                    loss=self.loss,
+                    reg=_reg_context(coord_cfg.optimizer, weight, 1, None),
+                    norm=NormalizationContext.identity(),
+                )
+                if isinstance(feats, np.ndarray):
+                    coords[coord_cfg.name] = build_random_effect_coordinate(
+                        coord_cfg.entity_key, train, coord_cfg.feature_shard,
+                        objective, config=ocfg,
+                        optimizer=coord_cfg.optimizer.optimizer,
+                    )
+                else:
+                    coords[coord_cfg.name] = (
+                        build_random_effect_coordinate_sparse(
+                            coord_cfg.entity_key, train,
+                            coord_cfg.feature_shard, objective,
+                            global_dim=train.feature_dim(
+                                coord_cfg.feature_shard),
+                            config=ocfg,
+                            optimizer=coord_cfg.optimizer.optimizer,
+                        )
+                    )
+                # Coordinate was registered under entity_key by the
+                # builder; expose it under the coordinate name.
+                coords[coord_cfg.name].name = coord_cfg.name
+        return coords
+
+    # -- model export ------------------------------------------------------
+
+    def _export_fixed(self, coord: FixedEffectCoordinate, w,
+                      coord_cfg: CoordinateConfig) -> FixedEffectModel:
+        """Export in RAW feature space: scale by normalization factors and
+        fold the margin shift-correction into the intercept (its presence
+        under shifts is validated in _prepare_fixed), so saved models
+        score raw features with a plain dot product."""
+        norm = coord.problem.objective.norm
+        w_raw = np.asarray(norm.model_to_raw(w)).copy()
+        if norm.shifts is not None:
+            w_raw[-1] -= float(norm.margin_correction(w))
+        return FixedEffectModel(
+            coefficients=Coefficients(means=jnp.asarray(w_raw)),
+            feature_shard=coord_cfg.feature_shard,
+            intercept=self.config.intercept,
+        )
+
+    def _to_game_model(self, coords, coefficients) -> GameModel:
+        models = {}
+        by_name = {c.name: c for c in self.config.coordinates}
+        for name, w in coefficients.items():
+            coord_cfg = by_name[name]
+            coord = coords[name]
+            if coord_cfg.kind == CoordinateKind.FIXED_EFFECT:
+                models[name] = self._export_fixed(coord, w, coord_cfg)
+            else:
+                models[name] = coord.as_model(w)
+                models[name].feature_shard = coord_cfg.feature_shard
+        return GameModel(models=models)
+
+    # -- fit ---------------------------------------------------------------
+
+    def _grid_points(self) -> list[dict]:
+        grid = self.config.reg_weight_grid
+        if not grid:
+            return [{}]
+        names = sorted(grid)
+        return [dict(zip(names, vals))
+                for vals in itertools.product(*(grid[n] for n in names))]
+
+    def _evaluate(self, model: GameModel, validation: GameDataset) -> dict:
+        transformer = GameTransformer(model=model, task=self.task)
+        margins = jnp.asarray(transformer.transform(validation))
+        labels = jnp.asarray(validation.labels.astype(np.float32))
+        weights = jnp.asarray(validation.weight_array())
+        out = {}
+        for ev in self.config.evaluators:
+            # RMSE/squared-loss evaluate mean-space, others margin-space
+            # (reference per-evaluator score conventions).
+            scores = margins
+            if ev.value in ("RMSE", "SQUARED_LOSS"):
+                scores = self.task.loss.mean(margins)
+            out[ev] = float(evaluate(ev, scores, labels, weights))
+        return out
+
+    def fit(self, train: GameDataset,
+            validation: GameDataset | None = None) -> list[FitResult]:
+        """Train once per grid point; returns results in grid order."""
+        cfg = self.config
+        prep = self._prepare(train)
+        results = []
+        for reg_weights in self._grid_points():
+            coords = self._build_coordinates(train, prep, reg_weights)
+            logger.info("fit: grid point %s", reg_weights or "(default)")
+            cd = run_coordinate_descent(
+                coordinates=coords,
+                update_sequence=cfg.update_sequence,
+                n_iterations=cfg.n_iterations,
+            )
+            model = self._to_game_model(coords, cd.coefficients)
+            evals = (self._evaluate(model, validation)
+                     if validation is not None else {})
+            results.append(FitResult(
+                model=model, evaluations=evals,
+                reg_weights={c.name: reg_weights.get(
+                    c.name, c.optimizer.reg_weight)
+                    for c in cfg.coordinates},
+            ))
+        return results
+
+    def best(self, results: list[FitResult]) -> FitResult:
+        """Model selection by the first evaluator (reference rule)."""
+        if not self.config.evaluators or not results[0].evaluations:
+            return results[0]
+        ev = self.config.evaluators[0]
+        best = results[0]
+        for r in results[1:]:
+            if bool(better_than(ev, r.evaluations[ev], best.evaluations[ev])):
+                best = r
+        return best

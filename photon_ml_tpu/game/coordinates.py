@@ -77,16 +77,27 @@ class FixedEffectCoordinate(Coordinate):
     ``FixedEffectCoordinate`` + ``DistributedOptimizationProblem``)."""
 
     name: str
-    batch: Batch                      # local or mesh-sharded
+    batch: Batch                      # full batch (scoring); local or sharded
     problem: OptimizationProblem
     distributed: DistributedGLMObjective | None = None  # set if sharded
+    # Down-sampled training view (reference DownSampler, SURVEY §2.4):
+    # train on batch rows ``train_idx`` with ``train_weights``; score all.
+    train_idx: Array | None = None
+    train_weights: Array | None = None
 
     def initial_coefficients(self) -> Array:
         return jnp.zeros((self.batch.dim,), jnp.float32)
 
+    def _training_batch(self, offsets: Array) -> Batch:
+        if self.train_idx is None:
+            return self.batch.replace(offsets=offsets)
+        sub = jax.tree.map(lambda a: a[self.train_idx], self.batch)
+        return sub.replace(offsets=offsets[self.train_idx],
+                           weights=self.train_weights)
+
     @partial(jax.jit, static_argnums=0)
     def _train_jit(self, offsets: Array, w0: Array):
-        batch = self.batch.replace(offsets=offsets)
+        batch = self._training_batch(offsets)
         if self.distributed is None:
             return self.problem.run(batch, w0)
         # Same solver over the psum-reduced objective.

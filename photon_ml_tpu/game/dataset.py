@@ -184,10 +184,21 @@ class GameDataset:
     entity_ids: dict  # random-effect coordinate name → [n] int array
     weights: np.ndarray | None = None
     offsets: np.ndarray | None = None
+    # Widths of sparse shards (dense shards infer from the array).
+    feature_dims: dict = dataclasses.field(default_factory=dict)
 
     @property
     def n(self) -> int:
         return len(self.labels)
+
+    def feature_dim(self, shard: str) -> int:
+        feats = self.features[shard]
+        if isinstance(feats, np.ndarray):
+            return feats.shape[1]
+        if shard in self.feature_dims:
+            return int(self.feature_dims[shard])
+        return int(max((int(c.max()) for c, _ in feats if len(c)),
+                       default=-1)) + 1
 
     def weight_array(self) -> np.ndarray:
         return (np.ones(self.n, np.float32) if self.weights is None

@@ -1,0 +1,133 @@
+"""GameModel persistence: save/load a model directory.
+
+Reference counterpart: ``ModelProcessingUtils`` writing per-coordinate
+``BayesianLinearModelAvro`` files to HDFS (photon-api
+``com.linkedin.photon.ml.io`` [expected paths, mount unavailable — see
+SURVEY.md §2.4/§3.1]).
+
+Layout: ``<dir>/metadata.json`` (task, coordinate kinds/shards) +
+``<dir>/<coordinate>.npz`` (fixed: means/variances; random: per-bucket
+coefficient blocks + the entity-level grouping index + projection
+feature ids).  npz+json is the environment's honest stand-in for Avro
+(no Avro lib baked in); the schema carries the same fields as
+``BayesianLinearModelAvro`` (means, variances, feature index mapping).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.dataset import EntityGrouping
+from photon_ml_tpu.game.projector import SubspaceProjection
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.models.glm import TaskType
+
+
+def save_game_model(model: GameModel, task: TaskType, out_dir: str) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    meta = {"task_type": task.value, "coordinates": {}}
+    for name, comp in model.models.items():
+        path = os.path.join(out_dir, f"{name}.npz")
+        if isinstance(comp, FixedEffectModel):
+            meta["coordinates"][name] = {
+                "kind": "FIXED_EFFECT", "feature_shard": comp.feature_shard,
+                "intercept": comp.intercept,
+            }
+            arrs = {"means": np.asarray(comp.coefficients.means)}
+            if comp.coefficients.variances is not None:
+                arrs["variances"] = np.asarray(comp.coefficients.variances)
+            np.savez(path, **arrs)
+        elif isinstance(comp, RandomEffectModel):
+            meta["coordinates"][name] = {
+                "kind": "RANDOM_EFFECT", "feature_shard": comp.feature_shard,
+                "n_buckets": len(comp.coefficient_blocks),
+                "projected": comp.projection is not None,
+                "global_dim": (comp.projection.global_dim
+                               if comp.projection else None),
+            }
+            g = comp.grouping
+            arrs = {
+                "entity_ids": g.entity_ids,
+                "entity_counts": g.entity_counts,
+                "entity_bucket": g.entity_bucket,
+                "entity_slot": g.entity_slot,
+                "capacities": np.asarray(g.capacities),
+                "n_entities": np.asarray(g.n_entities),
+            }
+            for b, blk in enumerate(comp.coefficient_blocks):
+                arrs[f"block_{b}"] = np.asarray(blk)
+            if comp.variance_blocks is not None:
+                for b, blk in enumerate(comp.variance_blocks):
+                    arrs[f"variance_block_{b}"] = np.asarray(blk)
+            if comp.projection is not None:
+                for b, fids in enumerate(comp.projection.feature_ids):
+                    arrs[f"proj_feature_ids_{b}"] = fids
+            np.savez(path, **arrs)
+        else:
+            raise TypeError(f"unknown component model {type(comp)}")
+    with open(os.path.join(out_dir, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_game_model(model_dir: str) -> tuple[GameModel, TaskType]:
+    with open(os.path.join(model_dir, "metadata.json")) as f:
+        meta = json.load(f)
+    task = TaskType(meta["task_type"])
+    models = {}
+    for name, info in meta["coordinates"].items():
+        data = np.load(os.path.join(model_dir, f"{name}.npz"))
+        if info["kind"] == "FIXED_EFFECT":
+            models[name] = FixedEffectModel(
+                coefficients=Coefficients(
+                    means=jnp.asarray(data["means"]),
+                    variances=(jnp.asarray(data["variances"])
+                               if "variances" in data else None),
+                ),
+                feature_shard=info["feature_shard"],
+                intercept=bool(info.get("intercept", False)),
+            )
+        else:
+            n_buckets = int(info["n_buckets"])
+            grouping = EntityGrouping(
+                n_examples=0,  # example-level maps are training-run state
+                entity_ids=data["entity_ids"],
+                entity_counts=data["entity_counts"],
+                entity_bucket=data["entity_bucket"],
+                entity_slot=data["entity_slot"],
+                capacities=[int(c) for c in data["capacities"]],
+                n_entities=[int(c) for c in data["n_entities"]],
+                example_bucket=np.empty(0, np.int64),
+                example_row=np.empty(0, np.int64),
+                example_col=np.empty(0, np.int64),
+            )
+            projection = None
+            if info.get("projected"):
+                projection = SubspaceProjection(
+                    feature_ids=[data[f"proj_feature_ids_{b}"]
+                                 for b in range(n_buckets)],
+                    global_dim=int(info["global_dim"]),
+                )
+            variance_blocks = None
+            if f"variance_block_0" in data:
+                variance_blocks = [
+                    jnp.asarray(data[f"variance_block_{b}"])
+                    for b in range(n_buckets)
+                ]
+            models[name] = RandomEffectModel(
+                coefficient_blocks=[jnp.asarray(data[f"block_{b}"])
+                                    for b in range(n_buckets)],
+                grouping=grouping,
+                feature_shard=info["feature_shard"],
+                variance_blocks=variance_blocks,
+                projection=projection,
+            )
+    return GameModel(models=models), task

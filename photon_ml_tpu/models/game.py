@@ -34,10 +34,15 @@ Array = jax.Array
 
 @dataclasses.dataclass
 class FixedEffectModel:
-    """Global coefficients for one feature shard."""
+    """Global coefficients for one feature shard.
+
+    ``intercept``: the last coefficient is an intercept the estimator
+    appended — scorers append a 1s column to raw features to match.
+    """
 
     coefficients: Coefficients
     feature_shard: str = "global"
+    intercept: bool = False
 
     @property
     def dim(self) -> int:

@@ -1,0 +1,145 @@
+"""GameEstimator / GameTransformer / model-IO integration tests
+(reference GameEstimatorIntegTest class of coverage, SURVEY.md §4)."""
+
+import numpy as np
+
+from photon_ml_tpu.config import (
+    CoordinateConfig,
+    CoordinateKind,
+    OptimizerSettings,
+    TrainingConfig,
+    config_to_json,
+    training_config_from_json,
+)
+from photon_ml_tpu.estimators import GameEstimator, GameTransformer
+from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+from photon_ml_tpu.models.glm import TaskType
+from photon_ml_tpu.ops.regularization import RegularizationType
+from photon_ml_tpu.optim.base import OptimizerType
+from photon_ml_tpu.utils.synthetic import make_movielens_like
+
+
+def _split(data, n_train):
+    def cut(a):
+        return a[:n_train], a[n_train:]
+
+    x_tr, x_va = cut(data["x"])
+    y_tr, y_va = cut(data["labels"])
+    u_tr, u_va = cut(data["user_ids"])
+    n_tr, n_va = len(y_tr), len(y_va)
+    train = GameDataset(
+        labels=y_tr,
+        features={"global": x_tr, "user_re": np.ones((n_tr, 1), np.float32)},
+        entity_ids={"per_user": u_tr},
+    )
+    valid = GameDataset(
+        labels=y_va,
+        features={"global": x_va, "user_re": np.ones((n_va, 1), np.float32)},
+        entity_ids={"per_user": u_va},
+    )
+    return train, valid
+
+
+def _config(**over):
+    base = dict(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinates=[
+            CoordinateConfig(
+                name="global",
+                kind=CoordinateKind.FIXED_EFFECT,
+                feature_shard="global",
+                optimizer=OptimizerSettings(reg_weight=1.0, max_iters=100),
+            ),
+            CoordinateConfig(
+                name="per_user",
+                kind=CoordinateKind.RANDOM_EFFECT,
+                feature_shard="user_re",
+                entity_key="per_user",
+                optimizer=OptimizerSettings(reg_weight=2.0, max_iters=50),
+            ),
+        ],
+        update_sequence=["global", "per_user"],
+        n_iterations=2,
+        evaluators=[EvaluatorType.AUC, EvaluatorType.LOGISTIC_LOSS],
+    )
+    base.update(over)
+    return TrainingConfig(**base)
+
+
+def test_estimator_fit_grid_and_selection(tmp_path):
+    data = make_movielens_like(n_users=100, n_items=1, n_obs=5000, seed=3)
+    train, valid = _split(data, 4000)
+    cfg = _config(reg_weight_grid={"global": [0.1, 10.0]})
+    est = GameEstimator(cfg)
+    results = est.fit(train, valid)
+    assert len(results) == 2
+    for r in results:
+        assert EvaluatorType.AUC in r.evaluations
+        assert 0.5 < r.evaluations[EvaluatorType.AUC] <= 1.0
+    best = est.best(results)
+    assert best.evaluations[EvaluatorType.AUC] == max(
+        r.evaluations[EvaluatorType.AUC] for r in results
+    )
+    # GAME model with user effects must beat 0.8 on this data.
+    assert best.evaluations[EvaluatorType.AUC] > 0.8
+
+    # save → load → rescore parity.
+    out = str(tmp_path / "model")
+    save_game_model(best.model, cfg.task_type, out)
+    loaded, task = load_game_model(out)
+    t1 = GameTransformer(model=best.model, task=cfg.task_type)
+    t2 = GameTransformer(model=loaded, task=task)
+    np.testing.assert_allclose(t1.transform(valid), t2.transform(valid),
+                               atol=1e-6)
+
+
+def test_estimator_with_intercept_and_standardization():
+    from photon_ml_tpu.data.normalization import NormalizationType
+
+    data = make_movielens_like(n_users=60, n_items=1, n_obs=3000, seed=9)
+    # Shift features so an intercept + standardization matter.
+    data["x"] = data["x"] * 2.5 + 1.7
+    train, valid = _split(data, 2400)
+    cfg = _config(normalization=NormalizationType.STANDARDIZATION,
+                  intercept=True)
+    est = GameEstimator(cfg)
+    best = est.best(est.fit(train, valid))
+    assert best.evaluations[EvaluatorType.AUC] > 0.8
+
+
+def test_estimator_down_sampling_path():
+    data = make_movielens_like(n_users=50, n_items=1, n_obs=3000, seed=17)
+    train, valid = _split(data, 2400)
+    cfg = _config()
+    cfg.coordinates[0].down_sampling_rate = 0.5
+    est = GameEstimator(cfg)
+    best = est.best(est.fit(train, valid))
+    assert best.evaluations[EvaluatorType.AUC] > 0.75
+
+
+def test_config_json_round_trip():
+    cfg = _config(reg_weight_grid={"global": [0.1, 1.0]})
+    text = config_to_json(cfg)
+    cfg2 = training_config_from_json(text)
+    assert cfg2.task_type == cfg.task_type
+    assert cfg2.coordinates[1].entity_key == "per_user"
+    assert cfg2.coordinates[0].optimizer.optimizer == OptimizerType.LBFGS
+    assert cfg2.evaluators == cfg.evaluators
+    assert cfg2.reg_weight_grid == {"global": [0.1, 1.0]}
+
+
+def test_config_validation_rejects_bad():
+    import pytest
+
+    cfg = _config()
+    cfg.update_sequence = ["nope"]
+    with pytest.raises(ValueError, match="update_sequence"):
+        cfg.validate()
+
+    cfg2 = _config()
+    cfg2.coordinates[0].optimizer.regularization = RegularizationType.L1
+    cfg2.coordinates[0].optimizer.optimizer = OptimizerType.TRON
+    with pytest.raises(ValueError, match="TRON"):
+        cfg2.validate()
