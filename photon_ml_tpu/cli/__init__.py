@@ -1,0 +1,7 @@
+"""Drivers / CLI entry points (reference photon-client layer, SURVEY §2.8).
+
+- ``game_training_driver`` — train GAME/GLM models from a config file
+  (the legacy single-GLM driver is its degenerate one-coordinate case);
+- ``game_scoring_driver`` — batch-score data with a saved model;
+- ``feature_indexing_driver`` — build (name, term) → index maps.
+"""

@@ -1,0 +1,133 @@
+"""GAME scoring driver: saved model + data → scores (+ evaluation).
+
+Reference counterpart: ``GameScoringDriver``
+(photon-client ``com.linkedin.photon.ml.cli.game.scoring`` [expected
+path, mount unavailable — see SURVEY.md §2.8/§3.2]): load model Avro +
+data, ``GameTransformer.transform``, write ``ScoringResultAvro``,
+optionally evaluate against true labels.
+
+Usage::
+
+    python -m photon_ml_tpu.cli.game_scoring_driver --config score.json
+
+Output is an ``.npz`` with raw margins (``scores``), mean-space
+predictions (``predictions`` — sigmoid/identity/exp per task), and the
+input ``labels`` — the same fields ``ScoringResultAvro`` carries —
+plus ``evaluation.json`` next to it when evaluators are configured.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.config import ScoringConfig, load_scoring_config
+from photon_ml_tpu.estimators.game_transformer import GameTransformer
+from photon_ml_tpu.evaluation import evaluate
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.io.dataset import detect_format, read_game_dataset
+from photon_ml_tpu.io.index_map import load_index_maps
+from photon_ml_tpu.io.libsvm import read_libsvm
+from photon_ml_tpu.io.model_io import load_game_model
+from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.utils.run_log import RunLogger
+
+
+def _read_data(config: ScoringConfig, model, log: RunLogger) -> GameDataset:
+    fmt = detect_format(config.input_path, config.input_format)
+    if fmt == "libsvm":
+        fixed = [m for m in model.models.values()
+                 if isinstance(m, FixedEffectModel)]
+        if len(model.models) != 1 or not fixed:
+            raise ValueError("LIBSVM scoring needs a single fixed-effect "
+                             "model; use JSONL records for GAME models")
+        shard = fixed[0].feature_shard
+        # Model width fixes the feature space (minus the intercept column
+        # the estimator appended at training time).
+        dim = len(np.asarray(fixed[0].coefficients.means))
+        if fixed[0].intercept:
+            dim -= 1
+        with log.timed("read_scoring_data", format=fmt):
+            rows, labels, _ = read_libsvm(config.input_path, n_features=dim)
+        return GameDataset(labels=labels, features={shard: rows},
+                           entity_ids={}, feature_dims={shard: dim})
+
+    index_dir = config.index_dir or os.path.join(
+        os.path.dirname(os.path.abspath(config.model_dir)), "index_maps")
+    with log.timed("prepare_feature_maps"):
+        feature_maps, entity_maps = load_index_maps(index_dir)
+    # Non-projected random effects score with a dense per-entity shard;
+    # the model knows which those are — no config repetition required.
+    dense = set(config.dense_feature_shards)
+    dense.update(
+        m.feature_shard for m in model.models.values()
+        if isinstance(m, RandomEffectModel) and m.projection is None
+    )
+    with log.timed("read_scoring_data", format=fmt):
+        return read_game_dataset(
+            config.input_path, feature_maps, entity_maps,
+            dense_shards=tuple(dense),
+        )
+
+
+def run(config: ScoringConfig, log: RunLogger | None = None) -> dict:
+    out_dir = os.path.dirname(os.path.abspath(config.output_path))
+    os.makedirs(out_dir, exist_ok=True)
+    if log is None:
+        log = RunLogger(os.path.join(out_dir, "scoring_log.jsonl"))
+    try:
+        return _run(config, log)
+    finally:
+        log.close()
+
+
+def _run(config: ScoringConfig, log: RunLogger) -> dict:
+    out_dir = os.path.dirname(os.path.abspath(config.output_path))
+    with log.timed("load_model"):
+        model, task = load_game_model(config.model_dir)
+    data = _read_data(config, model, log)
+    log.event("dataset", n=data.n)
+
+    transformer = GameTransformer(model=model, task=task)
+    with log.timed("transform"):
+        margins = transformer.transform(data)
+    predictions = np.asarray(task.loss.mean(jnp.asarray(margins)))
+
+    np.savez(config.output_path, scores=margins, predictions=predictions,
+             labels=data.labels)
+
+    evaluation = {}
+    if config.evaluators:
+        labels = jnp.asarray(data.labels.astype(np.float32))
+        weights = jnp.asarray(data.weight_array())
+        for ev in config.evaluators:
+            scores = jnp.asarray(margins)
+            if ev.value in ("RMSE", "SQUARED_LOSS"):
+                scores = jnp.asarray(predictions)
+            evaluation[ev.value] = float(
+                evaluate(ev, scores, labels, weights))
+        with open(os.path.join(out_dir, "evaluation.json"), "w") as f:
+            json.dump(evaluation, f, indent=2)
+        log.event("evaluation", **evaluation)
+
+    log.event("done", output=config.output_path)
+    return {"output_path": config.output_path, "n": int(data.n),
+            "evaluation": evaluation}
+
+
+def main(argv: list[str] | None = None) -> dict:
+    parser = argparse.ArgumentParser(
+        description="photon-ml-tpu GAME scoring driver"
+    )
+    parser.add_argument("--config", required=True,
+                        help="scoring config JSON file")
+    args = parser.parse_args(argv)
+    return run(load_scoring_config(args.config))
+
+
+if __name__ == "__main__":
+    main()

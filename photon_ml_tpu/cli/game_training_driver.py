@@ -1,0 +1,201 @@
+"""GAME training driver: config file → trained, evaluated, saved models.
+
+Reference counterpart: ``GameTrainingDriver``
+(photon-client ``com.linkedin.photon.ml.cli.game.training`` [expected
+path, mount unavailable — see SURVEY.md §2.8/§3.1]): parse params,
+prepare feature maps, read train/validation data, build datasets, run
+``GameEstimator.fit`` over the optimization grid, select/save models.
+
+Usage::
+
+    python -m photon_ml_tpu.cli.game_training_driver --config cfg.json
+
+The classic single-GLM path (reference's legacy ``Driver``) is the
+degenerate case: one fixed-effect coordinate, LIBSVM input — exactly how
+the reference folded its pre-GAME trainer into GAME (SURVEY §3.3).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+from photon_ml_tpu.config import (
+    CoordinateKind,
+    TrainingConfig,
+    config_to_json,
+    load_training_config,
+)
+from photon_ml_tpu.estimators.game_estimator import FitResult, GameEstimator
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.io.dataset import (
+    build_index_maps,
+    detect_format,
+    read_game_dataset,
+)
+from photon_ml_tpu.io.index_map import load_index_maps, save_index_maps
+from photon_ml_tpu.io.libsvm import read_libsvm
+from photon_ml_tpu.io.model_io import save_game_model
+from photon_ml_tpu.utils.run_log import RunLogger
+
+
+def _read_libsvm_dataset(path: str, config: TrainingConfig,
+                         n_features: int | None = None) -> GameDataset:
+    """LIBSVM → single-shard GameDataset (a1a-class fixtures, §3.3)."""
+    fixed = [c for c in config.coordinates
+             if c.kind == CoordinateKind.FIXED_EFFECT]
+    if len(config.coordinates) != 1 or not fixed:
+        raise ValueError(
+            "LIBSVM input supports exactly one fixed-effect coordinate; "
+            "use JSONL records for GAME configs"
+        )
+    shard = fixed[0].feature_shard
+    rows, labels, dim = read_libsvm(path, n_features=n_features)
+    return GameDataset(
+        labels=labels, features={shard: rows}, entity_ids={},
+        feature_dims={shard: dim},
+    )
+
+
+def prepare_data(config: TrainingConfig, log: RunLogger):
+    """Read (+ index) train/validation data; the driver's ETL phase.
+
+    Returns (train, validation, feature_maps, entity_maps); maps are
+    None for LIBSVM input (indices are literal in the file).
+    """
+    fmt = detect_format(config.input_path, config.input_format)
+    feature_maps = entity_maps = None
+    if fmt == "libsvm":
+        with log.timed("read_training_data", format=fmt):
+            train = _read_libsvm_dataset(config.input_path, config)
+        valid = None
+        if config.validation_path:
+            with log.timed("read_validation_data", format=fmt):
+                valid = _read_libsvm_dataset(
+                    config.validation_path, config,
+                    n_features=train.feature_dim(
+                        next(iter(train.features))),
+                )
+    else:
+        shards = sorted({c.feature_shard for c in config.coordinates})
+        entity_keys = sorted({c.entity_key for c in config.coordinates
+                              if c.entity_key})
+        with log.timed("prepare_feature_maps"):
+            if config.index_dir:
+                feature_maps, entity_maps = load_index_maps(config.index_dir)
+            else:
+                feature_maps, entity_maps = build_index_maps(
+                    config.input_path, shards, entity_keys
+                )
+        dense = tuple(config.dense_feature_shards)
+        with log.timed("read_training_data", format=fmt):
+            # Training extends the entity maps with ids the prebuilt
+            # maps miss; the extended maps are what gets persisted.
+            train = read_game_dataset(
+                config.input_path, feature_maps, entity_maps,
+                dense_shards=dense, extend_entity_maps=True,
+            )
+        valid = None
+        if config.validation_path:
+            with log.timed("read_validation_data", format=fmt):
+                valid = read_game_dataset(
+                    config.validation_path, feature_maps, entity_maps,
+                    dense_shards=dense,
+                )
+
+    if valid is None and config.validation_fraction > 0.0:
+        rng = np.random.default_rng(config.seed)
+        perm = rng.permutation(train.n)
+        n_valid = int(round(train.n * config.validation_fraction))
+        valid = train.take(perm[:n_valid])
+        train = train.take(perm[n_valid:])
+        log.event("validation_split", n_train=train.n, n_valid=valid.n)
+
+    return train, valid, feature_maps, entity_maps
+
+
+def _save_result(result: FitResult, estimator: GameEstimator,
+                 model_dir: str) -> dict:
+    save_game_model(result.model, estimator.task, model_dir)
+    return {
+        "model_dir": model_dir,
+        "reg_weights": result.reg_weights,
+        "evaluations": {ev.value: v for ev, v in result.evaluations.items()},
+    }
+
+
+def run(config: TrainingConfig, log: RunLogger | None = None) -> dict:
+    """Full training pipeline; returns the written summary dict."""
+    config.validate()
+    os.makedirs(config.output_dir, exist_ok=True)
+    if log is None:
+        log = RunLogger(os.path.join(config.output_dir, "run_log.jsonl"))
+    try:
+        return _run(config, log)
+    finally:
+        log.close()
+
+
+def _run(config: TrainingConfig, log: RunLogger) -> dict:
+    log.event("config", config=json.loads(config_to_json(config)))
+
+    train, valid, feature_maps, entity_maps = prepare_data(config, log)
+    log.event("datasets", n_train=train.n,
+              n_valid=(valid.n if valid is not None else 0))
+
+    estimator = GameEstimator(config)
+    with log.timed("fit"):
+        results = estimator.fit(train, validation=valid)
+    best = estimator.best(results)
+
+    for i, r in enumerate(results):
+        log.event("grid_result", index=i, reg_weights=r.reg_weights,
+                  evaluations={ev.value: v
+                               for ev, v in r.evaluations.items()},
+                  best=(r is best))
+
+    # Identity, not ==: FitResult equality would recurse into jax arrays.
+    summary = {"models": [],
+               "best_index": next(i for i, r in enumerate(results)
+                                  if r is best)}
+    with log.timed("save_models", mode=config.model_output_mode):
+        if config.model_output_mode == "ALL":
+            for i, r in enumerate(results):
+                summary["models"].append(_save_result(
+                    r, estimator,
+                    os.path.join(config.output_dir, f"model_{i}")))
+        else:  # BEST (EXPLICIT reduces to BEST without a tuning run)
+            summary["models"].append(_save_result(
+                best, estimator, os.path.join(config.output_dir, "model")))
+        if feature_maps is not None:
+            save_index_maps(os.path.join(config.output_dir, "index_maps"),
+                            feature_maps, entity_maps)
+
+    with open(os.path.join(config.output_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    with open(os.path.join(config.output_dir, "config.json"), "w") as f:
+        f.write(config_to_json(config))
+    log.event("done", best_index=summary["best_index"])
+    return summary
+
+
+def main(argv: list[str] | None = None) -> dict:
+    parser = argparse.ArgumentParser(
+        description="photon-ml-tpu GAME training driver"
+    )
+    parser.add_argument("--config", required=True,
+                        help="training config JSON file")
+    parser.add_argument("--output-dir", default=None,
+                        help="override config output_dir")
+    args = parser.parse_args(argv)
+    config = load_training_config(args.config)
+    if args.output_dir:
+        config.output_dir = args.output_dir
+    return run(config)
+
+
+if __name__ == "__main__":
+    main()

@@ -94,9 +94,12 @@ class TrainingConfig:
     coordinates: list[CoordinateConfig]
     update_sequence: list[str]
     input_path: str = ""
+    input_format: str = "auto"             # auto | jsonl | libsvm
     validation_path: str | None = None
     validation_fraction: float = 0.0       # split from input if no file
     output_dir: str = "output"
+    index_dir: str | None = None           # prebuilt index maps (else scan)
+    dense_feature_shards: list[str] = dataclasses.field(default_factory=list)
     n_iterations: int = 1
     normalization: NormalizationType = NormalizationType.NONE
     evaluators: list[EvaluatorType] = dataclasses.field(
@@ -145,6 +148,9 @@ class ScoringConfig:
     input_path: str
     model_dir: str
     output_path: str = "scores.npz"
+    input_format: str = "auto"             # auto | jsonl | libsvm
+    index_dir: str | None = None           # default: <model_dir>/../index_maps
+    dense_feature_shards: list[str] = dataclasses.field(default_factory=list)
     evaluators: list[EvaluatorType] = dataclasses.field(default_factory=list)
 
 

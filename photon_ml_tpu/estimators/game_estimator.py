@@ -259,6 +259,7 @@ class GameEstimator:
             else:
                 models[name] = coord.as_model(w)
                 models[name].feature_shard = coord_cfg.feature_shard
+                models[name].entity_key = coord_cfg.entity_key
         return GameModel(models=models)
 
     # -- fit ---------------------------------------------------------------

@@ -97,7 +97,7 @@ class GameTransformer:
             if isinstance(comp, FixedEffectModel):
                 total += _score_fixed(comp, dataset)
             elif isinstance(comp, RandomEffectModel):
-                ids = dataset.entity_ids[name]
+                ids = dataset.entity_ids[comp.entity_key or name]
                 total += _score_random(comp, ids, dataset)
             else:
                 raise TypeError(f"unknown component model {type(comp)}")

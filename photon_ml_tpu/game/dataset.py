@@ -204,6 +204,22 @@ class GameDataset:
         return (np.ones(self.n, np.float32) if self.weights is None
                 else self.weights.astype(np.float32))
 
+    def take(self, idx: np.ndarray) -> "GameDataset":
+        """Row subset (train/validation splits in the drivers)."""
+        def sub(feats):
+            if isinstance(feats, np.ndarray):
+                return feats[idx]
+            return [feats[int(i)] for i in idx]
+
+        return GameDataset(
+            labels=self.labels[idx],
+            features={s: sub(f) for s, f in self.features.items()},
+            entity_ids={k: v[idx] for k, v in self.entity_ids.items()},
+            weights=None if self.weights is None else self.weights[idx],
+            offsets=None if self.offsets is None else self.offsets[idx],
+            feature_dims=dict(self.feature_dims),
+        )
+
     def offset_array(self) -> np.ndarray:
         return (np.zeros(self.n, np.float32) if self.offsets is None
                 else self.offsets.astype(np.float32))

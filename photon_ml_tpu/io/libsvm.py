@@ -59,6 +59,12 @@ def read_libsvm(
                 vals.append(float(v_str))
             c = np.asarray(idxs, np.int32)
             v = np.asarray(vals, np.float32)
+            if n_features is not None and len(c):
+                # Features outside the declared space (e.g. test-set
+                # indices a model never saw) are dropped, never allowed
+                # to dot into out-of-range coefficients.
+                keep = c < n_features
+                c, v = c[keep], v[keep]
             if len(c):
                 max_idx = max(max_idx, int(c.max()))
                 if len(np.unique(c)) != len(c):

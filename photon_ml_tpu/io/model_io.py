@@ -49,6 +49,7 @@ def save_game_model(model: GameModel, task: TaskType, out_dir: str) -> None:
         elif isinstance(comp, RandomEffectModel):
             meta["coordinates"][name] = {
                 "kind": "RANDOM_EFFECT", "feature_shard": comp.feature_shard,
+                "entity_key": comp.entity_key,
                 "n_buckets": len(comp.coefficient_blocks),
                 "projected": comp.projection is not None,
                 "global_dim": (comp.projection.global_dim
@@ -129,5 +130,6 @@ def load_game_model(model_dir: str) -> tuple[GameModel, TaskType]:
                 feature_shard=info["feature_shard"],
                 variance_blocks=variance_blocks,
                 projection=projection,
+                entity_key=info.get("entity_key"),
             )
     return GameModel(models=models), task

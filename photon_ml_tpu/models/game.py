@@ -66,6 +66,9 @@ class RandomEffectModel:
     feature_shard: str
     variance_blocks: list[Array] | None = None
     projection: "SubspaceProjection | None" = None
+    # Which GameDataset.entity_ids column tags examples for this model
+    # (reference REId key, e.g. "userId"); None → the coordinate name.
+    entity_key: str | None = None
 
     @property
     def n_entities(self) -> int:

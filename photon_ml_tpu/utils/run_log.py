@@ -1,0 +1,73 @@
+"""Run logging: structured JSONL event log + phase wall-clock timers.
+
+Reference counterparts: ``PhotonLogger`` (a log file written to the
+output dir in addition to log4j) and the ``Timed { }`` driver-phase
+timer utility (photon-client/photon-api utils [expected paths, mount
+unavailable — see SURVEY.md §5.1/§5.5]).
+
+The rebuild upgrades free-text logs to structured JSONL — one event per
+line with a monotonic timestamp — so convergence traces and phase
+timings are machine-readable (the reference's observability gap).  The
+same events also go to the stdlib logger for human eyes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import time
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+class RunLogger:
+    """JSONL event sink; the reference's PhotonLogger role.
+
+    Events: ``{"t": <seconds-since-start>, "event": <kind>, ...}``.
+    A ``None`` path makes it a pure stdlib-logging sink (tests, library
+    use); drivers point it at ``<output_dir>/run_log.jsonl``.
+    """
+
+    def __init__(self, path: str | None = None, mode: str = "w"):
+        """``mode="w"`` (default) makes each run's log self-contained —
+        rerunning into the same output dir must not interleave events
+        from prior runs; pass ``"a"`` to accumulate deliberately."""
+        self.path = path
+        self._t0 = time.monotonic()
+        self._f = None
+        if path is not None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._f = open(path, mode)
+
+    def event(self, kind: str, **fields) -> None:
+        rec = {"t": round(time.monotonic() - self._t0, 6), "event": kind}
+        rec.update(fields)
+        if self._f is not None:
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+        logger.info("%s %s", kind, fields)
+
+    @contextlib.contextmanager
+    def timed(self, phase: str, **fields):
+        """The reference's ``Timed { }``: log phase start/end + duration."""
+        self.event("phase_start", phase=phase, **fields)
+        start = time.monotonic()
+        try:
+            yield
+        finally:
+            self.event(
+                "phase_end", phase=phase,
+                duration_s=round(time.monotonic() - start, 6), **fields,
+            )
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def read_run_log(path: str) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
