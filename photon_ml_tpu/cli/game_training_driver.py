@@ -148,7 +148,7 @@ def _run(config: TrainingConfig, log: RunLogger) -> dict:
 
     estimator = GameEstimator(config)
     with log.timed("fit"):
-        results = estimator.fit(train, validation=valid)
+        results = estimator.fit(train, validation=valid, run_logger=log)
     best = estimator.best(results)
 
     for i, r in enumerate(results):

@@ -24,6 +24,7 @@ from photon_ml_tpu.evaluation.evaluators import EvaluatorType
 from photon_ml_tpu.models.glm import TaskType
 from photon_ml_tpu.ops.regularization import RegularizationType
 from photon_ml_tpu.optim.base import OptimizerType
+from photon_ml_tpu.optim.variance import VarianceComputationType
 
 
 class CoordinateKind(str, enum.Enum):
@@ -43,6 +44,7 @@ class OptimizerSettings:
     regularization: RegularizationType = RegularizationType.L2
     reg_weight: float = 1.0
     elastic_net_alpha: float = 0.5  # only for ELASTIC_NET
+    variance_type: VarianceComputationType = VarianceComputationType.NONE
 
     def validate(self) -> None:
         if self.max_iters <= 0:
@@ -113,6 +115,13 @@ class TrainingConfig:
     model_output_mode: str = "BEST"        # ALL | BEST | EXPLICIT
     warm_start_model_dir: str | None = None
     locked_coordinates: list[str] = dataclasses.field(default_factory=list)
+    # Incremental training: regularize toward the warm-start model's
+    # coefficients with strength prior_weight/σ² when it has variances
+    # (reference PriorDistribution semantics).
+    use_warm_start_as_prior: bool = False
+    prior_weight: float = 1.0
+    checkpoint_dir: str | None = None      # per-CD-iteration checkpoints
+    resume: bool = False                   # resume from latest checkpoint
     intercept: bool = True
     seed: int = 0
 
@@ -128,6 +137,17 @@ class TrainingConfig:
         for s in self.locked_coordinates:
             if s not in names:
                 raise ValueError(f"locked coordinate '{s}' unknown")
+        if self.locked_coordinates and not self.warm_start_model_dir:
+            raise ValueError(
+                "locked_coordinates require warm_start_model_dir (locked "
+                "coefficients come from the previous model)"
+            )
+        if self.use_warm_start_as_prior and not self.warm_start_model_dir:
+            raise ValueError(
+                "use_warm_start_as_prior requires warm_start_model_dir"
+            )
+        if self.resume and not self.checkpoint_dir:
+            raise ValueError("resume requires checkpoint_dir")
         if not 0.0 <= self.validation_fraction < 1.0:
             raise ValueError("validation_fraction must be in [0, 1)")
         if self.n_iterations <= 0:
@@ -198,6 +218,7 @@ _ENUMS = {
     "RegularizationType": RegularizationType,
     "NormalizationType": NormalizationType,
     "EvaluatorType": EvaluatorType,
+    "VarianceComputationType": VarianceComputationType,
 }
 
 

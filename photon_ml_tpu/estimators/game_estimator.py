@@ -53,14 +53,20 @@ from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
 from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.game.sampling import binary_classification_down_sample
 from photon_ml_tpu.models.coefficients import Coefficients
-from photon_ml_tpu.models.game import FixedEffectModel, GameModel
+from photon_ml_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
 from photon_ml_tpu.models.glm import TaskType
 from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.prior import GaussianPrior
 from photon_ml_tpu.ops.regularization import (
     RegularizationContext,
     RegularizationType,
 )
 from photon_ml_tpu.optim import OptimizationProblem, OptimizerConfig
+from photon_ml_tpu.optim.variance import VarianceComputationType
 
 logger = logging.getLogger(__name__)
 
@@ -106,6 +112,15 @@ class GameEstimator:
         self.config = config
         self.task = config.task_type
         self.loss = self.task.loss
+        self._warm_model = None
+        if config.warm_start_model_dir:
+            from photon_ml_tpu.io.model_io import load_game_model
+
+            self._warm_model, warm_task = load_game_model(
+                config.warm_start_model_dir)
+            if warm_task != self.task:
+                raise ValueError(
+                    f"warm-start model task {warm_task} != {self.task}")
 
     # -- dataset preparation (once) ----------------------------------------
 
@@ -172,6 +187,78 @@ class GameEstimator:
             "train_idx": train_idx, "train_weights": train_weights,
         }
 
+    # -- warm-start import (saved raw-space model → training space) --------
+
+    def _import_fixed(self, comp: FixedEffectModel, p: dict):
+        """Invert ``_export_fixed``: raw-space means (+variances) →
+        model-space (means, variances)."""
+        w_raw = np.asarray(comp.coefficients.means, np.float64)
+        dim, ii = p["dim"], p["intercept_index"]
+        if len(w_raw) != dim:
+            raise ValueError(
+                f"warm-start fixed-effect dim {len(w_raw)} != {dim} "
+                "(feature space changed; rebuild index maps)")
+        norm = p["norm"]
+        f = (np.asarray(norm.factors, np.float64)
+             if norm.factors is not None else np.ones(dim))
+        wm = w_raw / f
+        if norm.shifts is not None and ii is not None:
+            # Undo the margin-correction fold into the intercept; the
+            # correction only involves non-intercept coords (shift=0 at
+            # the intercept), all already final in wm.
+            s = np.asarray(norm.shifts, np.float64)
+            wm[ii] = w_raw[ii] + float(np.dot(s * f, wm))
+        variances = None
+        if comp.coefficients.variances is not None:
+            # var scales as the square of the linear reparameterization
+            # (intercept cross-terms under shifts ignored — documented).
+            variances = np.asarray(comp.coefficients.variances,
+                                   np.float64) / (f * f)
+        return (jnp.asarray(wm.astype(np.float32)),
+                None if variances is None
+                else jnp.asarray(variances.astype(np.float32)))
+
+    def _import_random(self, comp: RandomEffectModel, coord):
+        """Map a saved RandomEffectModel onto a (possibly different)
+        training-run grouping by entity id; unseen entities start at 0."""
+        w0s = [np.zeros((blk.shape[0], blk.shape[-1]), np.float32)
+               for blk in coord.x_blocks]
+        g = coord.grouping
+        for e in range(g.n_total_entities):
+            eid = g.entity_ids[e]
+            b, s = int(g.entity_bucket[e]), int(g.entity_slot[e])
+            if coord.projection is None:
+                w = (comp.coefficients_for(eid)
+                     if comp.projection is None
+                     else comp.global_coefficients_for(eid))
+                if w is not None and len(w) == w0s[b].shape[1]:
+                    w0s[b][s] = w
+            else:
+                w_g = comp.global_coefficients_for(eid)
+                if w_g is None:
+                    continue
+                fids = coord.projection.feature_ids[b][s]
+                valid = fids >= 0
+                loc = np.zeros(w0s[b].shape[1], np.float32)
+                loc[valid] = w_g[fids[valid]]
+                w0s[b][s] = loc
+        return [jnp.asarray(w) for w in w0s]
+
+    def _warm_coefficients(self, coords: dict, prep: dict) -> dict:
+        """Per-coordinate starting coefficients from the warm model."""
+        out = {}
+        if self._warm_model is None:
+            return out
+        by_name = {c.name: c for c in self.config.coordinates}
+        for name, comp in self._warm_model.models.items():
+            if name not in coords:
+                continue
+            if by_name[name].kind == CoordinateKind.FIXED_EFFECT:
+                out[name], _ = self._import_fixed(comp, prep[name])
+            else:
+                out[name] = self._import_random(comp, coords[name])
+        return out
+
     # -- coordinate construction (per grid point) --------------------------
 
     def _build_coordinates(self, train: GameDataset, prep: dict,
@@ -184,11 +271,21 @@ class GameEstimator:
             ocfg = _optimizer_config(coord_cfg.optimizer)
             if coord_cfg.kind == CoordinateKind.FIXED_EFFECT:
                 p = prep[coord_cfg.name]
+                prior = None
+                if (cfg.use_warm_start_as_prior
+                        and self._warm_model is not None
+                        and coord_cfg.name in self._warm_model.models):
+                    comp = self._warm_model.models[coord_cfg.name]
+                    means, variances = self._import_fixed(comp, p)
+                    if variances is not None:
+                        prior = GaussianPrior.from_model(
+                            means, variances, cfg.prior_weight)
                 objective = GLMObjective(
                     loss=self.loss,
                     reg=_reg_context(coord_cfg.optimizer, weight, p["dim"],
                                      p["intercept_index"]),
                     norm=p["norm"],
+                    prior=prior,
                 )
                 coords[coord_cfg.name] = FixedEffectCoordinate(
                     name=coord_cfg.name,
@@ -233,7 +330,8 @@ class GameEstimator:
     # -- model export ------------------------------------------------------
 
     def _export_fixed(self, coord: FixedEffectCoordinate, w,
-                      coord_cfg: CoordinateConfig) -> FixedEffectModel:
+                      coord_cfg: CoordinateConfig,
+                      variances=None) -> FixedEffectModel:
         """Export in RAW feature space: scale by normalization factors and
         fold the margin shift-correction into the intercept (its presence
         under shifts is validated in _prepare_fixed), so saved models
@@ -242,22 +340,41 @@ class GameEstimator:
         w_raw = np.asarray(norm.model_to_raw(w)).copy()
         if norm.shifts is not None:
             w_raw[-1] -= float(norm.margin_correction(w))
+        var_raw = None
+        if variances is not None:
+            # Variances scale with the square of the reparameterization.
+            f = (np.asarray(norm.factors)
+                 if norm.factors is not None
+                 else np.ones_like(w_raw))
+            var_raw = jnp.asarray(np.asarray(variances) * f * f)
         return FixedEffectModel(
-            coefficients=Coefficients(means=jnp.asarray(w_raw)),
+            coefficients=Coefficients(means=jnp.asarray(w_raw),
+                                      variances=var_raw),
             feature_shard=coord_cfg.feature_shard,
             intercept=self.config.intercept,
         )
 
-    def _to_game_model(self, coords, coefficients) -> GameModel:
+    def _to_game_model(self, coords, cd) -> GameModel:
         models = {}
         by_name = {c.name: c for c in self.config.coordinates}
-        for name, w in coefficients.items():
+        for name, w in cd.coefficients.items():
             coord_cfg = by_name[name]
             coord = coords[name]
+            vtype = coord_cfg.optimizer.variance_type
+            offsets = cd.total_scores - cd.scores[name]
             if coord_cfg.kind == CoordinateKind.FIXED_EFFECT:
-                models[name] = self._export_fixed(coord, w, coord_cfg)
+                variances = None
+                if vtype != VarianceComputationType.NONE:
+                    variances = coord.compute_variances(w, offsets, vtype)
+                models[name] = self._export_fixed(
+                    coord, w, coord_cfg, variances)
             else:
                 models[name] = coord.as_model(w)
+                if vtype != VarianceComputationType.NONE:
+                    # Per-entity variances are SIMPLE by design (a FULL
+                    # inverse per entity is neither needed nor tractable).
+                    models[name].variance_blocks = (
+                        coord.compute_variance_blocks(w, offsets))
                 models[name].feature_shard = coord_cfg.feature_shard
                 models[name].entity_key = coord_cfg.entity_key
         return GameModel(models=models)
@@ -288,20 +405,41 @@ class GameEstimator:
         return out
 
     def fit(self, train: GameDataset,
-            validation: GameDataset | None = None) -> list[FitResult]:
+            validation: GameDataset | None = None,
+            run_logger=None) -> list[FitResult]:
         """Train once per grid point; returns results in grid order."""
         cfg = self.config
         prep = self._prepare(train)
+        grid_points = self._grid_points()
         results = []
-        for reg_weights in self._grid_points():
+        for gi, reg_weights in enumerate(grid_points):
             coords = self._build_coordinates(train, prep, reg_weights)
             logger.info("fit: grid point %s", reg_weights or "(default)")
+
+            warm = self._warm_coefficients(coords, prep)
+            locked = {name: warm[name] for name in cfg.locked_coordinates
+                      if name in warm}
+            missing = set(cfg.locked_coordinates) - set(locked)
+            if missing:
+                raise ValueError(
+                    f"locked coordinates {sorted(missing)} absent from "
+                    "the warm-start model")
+            initial = {n: w for n, w in warm.items() if n not in locked}
+
+            ckpt_dir = cfg.checkpoint_dir
+            if ckpt_dir and len(grid_points) > 1:
+                ckpt_dir = f"{ckpt_dir}/grid_{gi}"
             cd = run_coordinate_descent(
                 coordinates=coords,
                 update_sequence=cfg.update_sequence,
                 n_iterations=cfg.n_iterations,
+                locked_coordinates=locked,
+                initial_coefficients=initial,
+                checkpoint_dir=ckpt_dir,
+                resume=cfg.resume,
+                run_logger=run_logger,
             )
-            model = self._to_game_model(coords, cd.coefficients)
+            model = self._to_game_model(coords, cd)
             evals = (self._evaluate(model, validation)
                      if validation is not None else {})
             results.append(FitResult(

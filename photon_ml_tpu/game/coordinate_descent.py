@@ -34,6 +34,27 @@ from photon_ml_tpu.game.coordinates import Coordinate
 logger = logging.getLogger(__name__)
 
 
+def _diag_fields(diag) -> dict:
+    """Scalar convergence fields from a coordinate's train diagnostics
+    (an ``OptimizationResult`` for fixed effects; a per-bucket list of
+    batched results for random effects)."""
+    if hasattr(diag, "value") and jnp.ndim(diag.value) == 0:
+        return {
+            "value": float(diag.value),
+            "grad_norm": float(diag.grad_norm),
+            "solver_iterations": int(diag.iterations),
+            "converged": bool(diag.converged),
+        }
+    if isinstance(diag, (list, tuple)) and diag and hasattr(diag[0], "value"):
+        # Batched per-entity results: aggregate convergence stats.
+        n = sum(int(r.value.shape[0]) for r in diag)
+        conv = sum(int(jnp.sum(r.converged)) for r in diag)
+        iters = max(int(jnp.max(r.iterations)) for r in diag)
+        return {"entities": n, "entities_converged": conv,
+                "max_solver_iterations": iters}
+    return {}
+
+
 @dataclasses.dataclass
 class CoordinateDescentResult:
     """Trained coefficients per coordinate + per-iteration history."""
@@ -51,6 +72,10 @@ def run_coordinate_descent(
     n_iterations: int,
     validator=None,
     locked_coordinates: dict | None = None,
+    initial_coefficients: dict | None = None,
+    checkpoint_dir: str | None = None,
+    resume: bool = False,
+    run_logger=None,
 ) -> CoordinateDescentResult:
     """Run GAME coordinate descent.
 
@@ -65,36 +90,63 @@ def run_coordinate_descent(
       locked_coordinates: name → pre-trained coefficients for partial
         retraining (reference ``partialRetrainLockedCoordinates``):
         locked coordinates contribute scores but are never retrained.
+      initial_coefficients: name → starting coefficients (warm start
+        from a previous model, reference ``modelInputDir`` semantics):
+        the coordinate starts scored at these values instead of zero.
+      checkpoint_dir: if set, save (coefficients, iteration) after every
+        completed sweep (see ``photon_ml_tpu.utils.checkpoint``).
+      resume: resume from the latest checkpoint in ``checkpoint_dir``
+        (overrides ``initial_coefficients`` for checkpointed names).
+      run_logger: optional ``photon_ml_tpu.utils.run_log.RunLogger`` for
+        structured per-iteration events.
     """
     locked_coordinates = locked_coordinates or {}
+    initial_coefficients = dict(initial_coefficients or {})
     for name in update_sequence:
         if name not in coordinates and name not in locked_coordinates:
             raise ValueError(f"coordinate '{name}' has no trainable unit "
                              "and is not locked")
 
+    start_iteration = 0
+    if resume:
+        if not checkpoint_dir:
+            raise ValueError("resume=True requires checkpoint_dir")
+        from photon_ml_tpu.utils.checkpoint import load_latest_checkpoint
+
+        loaded = load_latest_checkpoint(checkpoint_dir)
+        if loaded is not None:
+            start_iteration, ckpt_coefs = loaded
+            initial_coefficients.update(ckpt_coefs)
+            if run_logger is not None:
+                run_logger.event("cd_resume", iteration=start_iteration)
+
     coefs: dict = {}
     scores: dict = {}
-    n = None
 
     # Locked coordinates score once, up front, and never move.
     for name, locked_coefs in locked_coordinates.items():
         coefs[name] = locked_coefs
         scores[name] = coordinates[name].score(locked_coefs)
 
-    # Initialize trainable scores at zero.
+    # Trainable coordinates start at their warm-start coefficients
+    # (scored in) or contribute zero until first trained.
     for name in update_sequence:
         if name in locked_coordinates:
             continue
-        s = coordinates[name].score(coordinates[name].initial_coefficients())
-        scores[name] = jnp.zeros_like(s)
-        n = s.shape[0]
+        if name in initial_coefficients:
+            coefs[name] = initial_coefficients[name]
+            scores[name] = coordinates[name].score(coefs[name])
+        else:
+            s = coordinates[name].score(
+                coordinates[name].initial_coefficients())
+            scores[name] = jnp.zeros_like(s)
 
     total = None
     for s in scores.values():
         total = s if total is None else total + s
 
     history, validation_history = [], []
-    for it in range(n_iterations):
+    for it in range(start_iteration, n_iterations):
         iter_diag = {}
         for name in update_sequence:
             if name in locked_coordinates:
@@ -108,16 +160,29 @@ def run_coordinate_descent(
             scores[name] = new_scores
             coefs[name] = w
             iter_diag[name] = diag
+            elapsed = time.perf_counter() - t0
             logger.info(
                 "CD iter %d coordinate %s trained in %.2fs",
-                it + 1, name, time.perf_counter() - t0,
+                it + 1, name, elapsed,
             )
+            if run_logger is not None:
+                run_logger.event(
+                    "cd_coordinate", iteration=it + 1, coordinate=name,
+                    duration_s=round(elapsed, 4), **_diag_fields(diag),
+                )
         history.append(iter_diag)
         if validator is not None:
             metric = validator(total)
             validation_history.append(metric)
             logger.info("CD iter %d validation metric %.6f", it + 1,
                         float(metric))
+            if run_logger is not None:
+                run_logger.event("cd_validation", iteration=it + 1,
+                                 metric=float(metric))
+        if checkpoint_dir is not None:
+            from photon_ml_tpu.utils.checkpoint import save_checkpoint
+
+            save_checkpoint(checkpoint_dir, it + 1, coefs)
 
     return CoordinateDescentResult(
         coefficients=coefs,

@@ -128,6 +128,17 @@ class FixedEffectCoordinate(Coordinate):
             feature_shard=self.name,
         )
 
+    def compute_variances(self, coefficients: Array, offsets: Array,
+                          variance_type) -> Array | None:
+        """Coefficient variances at the optimum over the training view
+        (reference VarianceComputationType pipeline, SURVEY §2.1)."""
+        from photon_ml_tpu.optim.variance import compute_variances
+
+        return compute_variances(
+            self.problem.objective, coefficients,
+            self._training_batch(offsets), variance_type,
+        )
+
 
 @dataclasses.dataclass(eq=False)
 class RandomEffectCoordinate(Coordinate):
@@ -199,6 +210,32 @@ class RandomEffectCoordinate(Coordinate):
             feature_shard=self.name,
             projection=self.projection,
         )
+
+    @partial(jax.jit, static_argnums=0)
+    def compute_variance_blocks(
+        self, coefficient_blocks: list[Array], offsets: Array
+    ) -> list[Array]:
+        """SIMPLE per-entity variances (1/diag H), vmapped per bucket —
+        the per-entity arm of the reference's variance pipeline."""
+        from photon_ml_tpu.optim.variance import simple_variances
+
+        out = []
+        for b, w_b in enumerate(coefficient_blocks):
+            off_blk = jnp.zeros_like(self.label_blocks[b]).at[
+                self.row_idx[b], self.col_idx[b]
+            ].set(offsets[self.ex_idx[b]])
+            batch_b = DenseBatch(
+                x=self.x_blocks[b],
+                labels=self.label_blocks[b],
+                weights=self.weight_blocks[b],
+                offsets=off_blk,
+                mask=self.mask_blocks[b],
+            )
+            out.append(jax.vmap(
+                lambda w, bb: simple_variances(
+                    self.problem.objective, w, bb)
+            )(w_b, batch_b))
+        return out
 
 
 def build_random_effect_coordinate(

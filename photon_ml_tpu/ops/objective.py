@@ -36,6 +36,7 @@ from flax import struct
 from photon_ml_tpu.data.batch import Batch
 from photon_ml_tpu.data.normalization import NormalizationContext
 from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.ops.prior import GaussianPrior
 from photon_ml_tpu.ops.regularization import RegularizationContext
 
 Array = jax.Array
@@ -54,6 +55,9 @@ class GLMObjective:
     loss: PointwiseLoss = struct.field(pytree_node=False)
     reg: RegularizationContext
     norm: NormalizationContext
+    # Optional Gaussian prior toward a previous model's coefficients
+    # (incremental training, reference PriorDistribution — see ops/prior.py).
+    prior: "GaussianPrior | None" = None
 
     # ---- internals --------------------------------------------------------
 
@@ -75,7 +79,10 @@ class GLMObjective:
         m = self._margins(w, batch)
         wl = batch.weights * batch.mask
         data_val = jnp.sum(wl * self.loss.loss(m, batch.labels))
-        return data_val + self.reg.l2_value(w)
+        val = data_val + self.reg.l2_value(w)
+        if self.prior is not None:
+            val = val + self.prior.value(w)
+        return val
 
     def value_and_gradient(self, w: Array, batch: Batch) -> tuple[Array, Array]:
         """The hot path: one fused pass for (value, gradient)."""
@@ -84,6 +91,9 @@ class GLMObjective:
         val = jnp.sum(wl * self.loss.loss(m, batch.labels)) + self.reg.l2_value(w)
         r = wl * self.loss.d1(m, batch.labels)
         grad = self._residual_to_grad(r, batch) + self.reg.l2_gradient(w)
+        if self.prior is not None:
+            val = val + self.prior.value(w)
+            grad = grad + self.prior.gradient(w)
         return val, grad
 
     def gradient(self, w: Array, batch: Batch) -> Array:
@@ -103,7 +113,10 @@ class GLMObjective:
         if not self.norm.is_identity:
             xv = xv - self.norm.margin_correction(v)
         r = d2 * xv
-        return self._residual_to_grad(r, batch) + self.reg.l2_hessian_vector(v)
+        out = self._residual_to_grad(r, batch) + self.reg.l2_hessian_vector(v)
+        if self.prior is not None:
+            out = out + self.prior.hessian_vector(v)
+        return out
 
     def hessian_diagonal(self, w: Array, batch: Batch) -> Array:
         """diag(X^T diag(wl·d2) X) + λ₂ — for SIMPLE variance computation.
@@ -116,10 +129,12 @@ class GLMObjective:
         wl = batch.weights * batch.mask
         d2 = wl * self.loss.d2(m, batch.labels)
 
+        prior_diag = (self.prior.hessian_diagonal()
+                      if self.prior is not None else 0.0)
         sq_batch = _elementwise_square_batch(batch)
         diag_raw = sq_batch.xt_dot(d2)          # Σ_i d2_i · x_ij²
         if self.norm.is_identity:
-            return diag_raw + self.reg.l2_hessian_diagonal(w)
+            return diag_raw + self.reg.l2_hessian_diagonal(w) + prior_diag
 
         f = (
             self.norm.factors
@@ -132,7 +147,7 @@ class GLMObjective:
             cross = batch.xt_dot(d2)            # Σ_i d2_i · x_ij
             total = jnp.sum(d2)                 # Σ_i d2_i
             diag = diag - 2.0 * f * f * s * cross + f * f * s * s * total
-        return diag + self.reg.l2_hessian_diagonal(w)
+        return diag + self.reg.l2_hessian_diagonal(w) + prior_diag
 
     # ---- conveniences -----------------------------------------------------
 

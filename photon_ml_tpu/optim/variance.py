@@ -1,0 +1,74 @@
+"""Coefficient variance computation from the Hessian at the optimum.
+
+Reference counterparts: ``VarianceComputationType`` (NONE/SIMPLE/FULL)
+and the variance path of ``GeneralizedLinearOptimizationProblem``
+(photon-api ``com.linkedin.photon.ml.optimization`` [expected paths,
+mount unavailable — see SURVEY.md §2.1]):
+
+- SIMPLE: var_j = 1 / H_jj — the reciprocal of the Hessian diagonal
+  (one fused aggregation pass, reference ``HessianDiagonalAggregator``);
+- FULL:   var_j = (H⁻¹)_jj — the diagonal of the inverse Hessian.
+
+TPU design: SIMPLE is a single ``hessian_diagonal`` kernel call.  FULL
+materializes H column-by-column with ``vmap``ped Hessian-vector products
+against the identity (d HVPs fused into one batched device program — an
+MXU-friendly [d, d] build) and Cholesky-solves for the inverse diagonal.
+FULL is meant for the fixed effect at GLM dims (the reference likewise
+reserves it for modest feature counts); per-entity variances under
+``vmap`` use SIMPLE.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import Batch
+from photon_ml_tpu.ops.objective import GLMObjective
+
+Array = jax.Array
+
+
+class VarianceComputationType(str, enum.Enum):
+    NONE = "NONE"
+    SIMPLE = "SIMPLE"
+    FULL = "FULL"
+
+
+def simple_variances(obj: GLMObjective, w: Array, batch: Batch) -> Array:
+    """1 / diag(H) at w (jittable, vmappable)."""
+    diag = obj.hessian_diagonal(w, batch)
+    return 1.0 / jnp.maximum(diag, 1e-12)
+
+
+def materialize_hessian(obj: GLMObjective, w: Array, batch: Batch) -> Array:
+    """[d, d] Hessian via batched HVPs against identity columns."""
+    dim = w.shape[-1]
+    eye = jnp.eye(dim, dtype=w.dtype)
+    return jax.vmap(lambda v: obj.hessian_vector(w, v, batch))(eye)
+
+
+def full_variances(obj: GLMObjective, w: Array, batch: Batch) -> Array:
+    """diag(H⁻¹) at w via Cholesky (H is SPD for convex GLM + L2)."""
+    h = materialize_hessian(obj, w, batch)
+    dim = w.shape[-1]
+    # Tiny jitter keeps the factorization stable when unregularized
+    # directions are nearly flat (reference relies on Breeze's solve).
+    chol = jax.scipy.linalg.cho_factor(h + 1e-8 * jnp.eye(dim, dtype=w.dtype))
+    inv = jax.scipy.linalg.cho_solve(chol, jnp.eye(dim, dtype=w.dtype))
+    return jnp.diagonal(inv)
+
+
+def compute_variances(
+    obj: GLMObjective,
+    w: Array,
+    batch: Batch,
+    variance_type: VarianceComputationType,
+) -> Array | None:
+    if variance_type == VarianceComputationType.NONE:
+        return None
+    if variance_type == VarianceComputationType.SIMPLE:
+        return simple_variances(obj, w, batch)
+    return full_variances(obj, w, batch)

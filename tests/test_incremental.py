@@ -1,0 +1,321 @@
+"""Warm start / priors / partial retraining / variance / checkpointing.
+
+Reference coverage class: incremental-training and variance tests of
+``GameEstimator``/``GeneralizedLinearOptimizationProblem`` (SURVEY.md
+§2.1 variance computation, §2.2 priors, §5.4 warm start / partial
+retraining / checkpointing).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.config import (
+    CoordinateConfig,
+    CoordinateKind,
+    OptimizerSettings,
+    TrainingConfig,
+)
+from photon_ml_tpu.data.batch import make_dense_batch
+from photon_ml_tpu.data.normalization import NormalizationContext
+from photon_ml_tpu.estimators import GameEstimator
+from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+from photon_ml_tpu.models.glm import TaskType
+from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.prior import GaussianPrior
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.optim.variance import (
+    VarianceComputationType,
+    compute_variances,
+    full_variances,
+    materialize_hessian,
+    simple_variances,
+)
+from photon_ml_tpu.utils.checkpoint import (
+    load_latest_checkpoint,
+    save_checkpoint,
+)
+from photon_ml_tpu.utils.synthetic import make_movielens_like
+
+
+def _logistic_problem(n=200, d=5, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    p = 1 / (1 + np.exp(-(x @ w_true)))
+    y = (rng.uniform(size=n) < p).astype(np.float32)
+    return make_dense_batch(x, y)
+
+
+# ---------------------------------------------------------------------------
+# Gaussian prior: objective consistency
+# ---------------------------------------------------------------------------
+
+def test_prior_value_gradient_hvp_consistency():
+    batch = _logistic_problem()
+    rng = np.random.default_rng(1)
+    mu = jnp.asarray(rng.normal(size=5).astype(np.float32))
+    var = jnp.asarray(rng.uniform(0.5, 2.0, size=5).astype(np.float32))
+    obj = GLMObjective(
+        loss=get_loss("LOGISTIC_REGRESSION"),
+        reg=RegularizationContext.l2(0.3),
+        norm=NormalizationContext.identity(),
+        prior=GaussianPrior.from_model(mu, var, weight=1.7),
+    )
+    w = jnp.asarray(rng.normal(size=5).astype(np.float32))
+
+    # value/gradient agree with autodiff of value
+    val, grad = obj.value_and_gradient(w, batch)
+    assert np.isclose(float(val), float(obj.value(w, batch)), rtol=1e-6)
+    grad_ad = jax.grad(lambda ww: obj.value(ww, batch))(w)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(grad_ad),
+                               rtol=1e-4, atol=1e-4)
+
+    # prior raises the objective away from mu and pulls the optimum
+    obj0 = obj.replace(prior=None)
+    assert float(obj.value(mu, batch)) < float(obj.value(mu + 1.0, batch))
+    assert float(obj.value(w, batch)) > float(obj0.value(w, batch))
+
+    # HVP includes the prior precision (diagonal quadratic)
+    v = jnp.ones(5)
+    hvp = obj.hessian_vector(w, v, batch)
+    hvp0 = obj0.hessian_vector(w, v, batch)
+    np.testing.assert_allclose(
+        np.asarray(hvp - hvp0), np.asarray(1.7 / var), rtol=1e-5
+    )
+    # Hessian diagonal too
+    hd = obj.hessian_diagonal(w, batch) - obj0.hessian_diagonal(w, batch)
+    np.testing.assert_allclose(np.asarray(hd), np.asarray(1.7 / var),
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Variance computation
+# ---------------------------------------------------------------------------
+
+def test_variances_against_materialized_hessian():
+    batch = _logistic_problem(n=300, d=4, seed=2)
+    obj = GLMObjective(
+        loss=get_loss("LOGISTIC_REGRESSION"),
+        reg=RegularizationContext.l2(0.5),
+        norm=NormalizationContext.identity(),
+    )
+    w = jnp.asarray(np.random.default_rng(3).normal(size=4), jnp.float32)
+
+    h = np.asarray(materialize_hessian(obj, w, batch))
+    # Hessian is symmetric and PD for logistic + L2
+    np.testing.assert_allclose(h, h.T, rtol=1e-4, atol=1e-5)
+
+    v_simple = np.asarray(simple_variances(obj, w, batch))
+    np.testing.assert_allclose(v_simple, 1.0 / np.diag(h), rtol=1e-4)
+
+    v_full = np.asarray(full_variances(obj, w, batch))
+    np.testing.assert_allclose(v_full, np.diag(np.linalg.inv(h)), rtol=1e-3)
+
+    # FULL >= SIMPLE elementwise (Schur complement inequality)
+    assert np.all(v_full >= v_simple * (1 - 1e-5))
+
+    assert compute_variances(obj, w, batch,
+                             VarianceComputationType.NONE) is None
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint round trip
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip(tmp_path):
+    coefs = {
+        "global": jnp.arange(4, dtype=jnp.float32),
+        "per_user": [jnp.ones((3, 2)), jnp.zeros((2, 5))],
+    }
+    assert load_latest_checkpoint(str(tmp_path)) is None
+    save_checkpoint(str(tmp_path), 1, coefs)
+    save_checkpoint(str(tmp_path), 2, coefs)
+    it, loaded = load_latest_checkpoint(str(tmp_path))
+    assert it == 2
+    np.testing.assert_array_equal(loaded["global"], coefs["global"])
+    assert len(loaded["per_user"]) == 2
+    np.testing.assert_array_equal(loaded["per_user"][1],
+                                  coefs["per_user"][1])
+
+
+# ---------------------------------------------------------------------------
+# Estimator-level: warm start, locking, prior, resume, variance export
+# ---------------------------------------------------------------------------
+
+def _game_data(n_obs=1500, seed=23):
+    data = make_movielens_like(n_users=25, n_items=10, n_obs=n_obs,
+                               dim_global=6, seed=seed)
+    n = len(data["labels"])
+    return GameDataset(
+        labels=data["labels"],
+        features={"global": data["x"].astype(np.float32),
+                  "user_re": np.ones((n, 1), np.float32)},
+        entity_ids={"per_user": data["user_ids"]},
+    )
+
+
+def _game_config(**over):
+    base = dict(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinates=[
+            CoordinateConfig(
+                name="global", kind=CoordinateKind.FIXED_EFFECT,
+                feature_shard="global",
+                optimizer=OptimizerSettings(reg_weight=1.0, max_iters=80),
+            ),
+            CoordinateConfig(
+                name="per_user", kind=CoordinateKind.RANDOM_EFFECT,
+                feature_shard="user_re", entity_key="per_user",
+                optimizer=OptimizerSettings(reg_weight=2.0, max_iters=40),
+            ),
+        ],
+        update_sequence=["global", "per_user"],
+        n_iterations=2,
+        evaluators=[EvaluatorType.AUC],
+    )
+    base.update(over)
+    return TrainingConfig(**base)
+
+
+def test_warm_start_reaches_same_solution_faster(tmp_path):
+    train = _game_data()
+    # Cold fit, save.
+    est = GameEstimator(_game_config())
+    res = est.fit(train)[0]
+    save_game_model(res.model, TaskType.LOGISTIC_REGRESSION,
+                    str(tmp_path / "m0"))
+    w0 = np.asarray(res.model.models["global"].coefficients.means)
+
+    # Warm restart with ONE more CD iteration continues where the cold
+    # fit stopped: it must match a cold THREE-iteration fit, not the
+    # 2-iteration starting point.
+    est2 = GameEstimator(_game_config(
+        warm_start_model_dir=str(tmp_path / "m0"), n_iterations=1))
+    res2 = est2.fit(train)[0]
+    w1 = np.asarray(res2.model.models["global"].coefficients.means)
+    res3 = GameEstimator(_game_config(n_iterations=3)).fit(train)[0]
+    w3 = np.asarray(res3.model.models["global"].coefficients.means)
+    np.testing.assert_allclose(w1, w3, atol=5e-3)
+    assert np.linalg.norm(w1 - w3) < np.linalg.norm(w1 - w0)
+
+    # RE warm start maps by entity id.
+    re0 = res.model.models["per_user"]
+    re1 = res2.model.models["per_user"]
+    for eid in re0.grouping.entity_ids[:5]:
+        a = re0.coefficients_for(int(eid))
+        b = re1.coefficients_for(int(eid))
+        np.testing.assert_allclose(a, b, atol=5e-2)
+
+
+def test_partial_retraining_locks_coordinate(tmp_path):
+    train = _game_data()
+    est = GameEstimator(_game_config())
+    res = est.fit(train)[0]
+    save_game_model(res.model, TaskType.LOGISTIC_REGRESSION,
+                    str(tmp_path / "m0"))
+    w_locked = np.asarray(res.model.models["global"].coefficients.means)
+
+    # Retrain on NEW data with the fixed effect locked.
+    train2 = _game_data(seed=31)
+    est2 = GameEstimator(_game_config(
+        warm_start_model_dir=str(tmp_path / "m0"),
+        locked_coordinates=["global"],
+    ))
+    res2 = est2.fit(train2)[0]
+    w_after = np.asarray(res2.model.models["global"].coefficients.means)
+    np.testing.assert_allclose(w_after, w_locked, atol=1e-6)
+
+    # The unlocked RE coordinate did move.
+    re_a = res.model.models["per_user"]
+    re_b = res2.model.models["per_user"]
+    eid = int(re_a.grouping.entity_ids[0])
+    assert not np.allclose(re_a.coefficients_for(eid),
+                           re_b.coefficients_for(eid), atol=1e-4)
+
+
+def test_locked_requires_warm_start():
+    with pytest.raises(ValueError, match="warm_start_model_dir"):
+        _game_config(locked_coordinates=["global"]).validate()
+
+
+def test_prior_pulls_solution_toward_warm_model(tmp_path):
+    train = _game_data()
+    cfg = _game_config()
+    cfg.coordinates[0].optimizer.variance_type = (
+        VarianceComputationType.FULL)
+    est = GameEstimator(cfg)
+    res = est.fit(train)[0]
+    assert res.model.models["global"].coefficients.variances is not None
+    save_game_model(res.model, TaskType.LOGISTIC_REGRESSION,
+                    str(tmp_path / "m0"))
+    w_prev = np.asarray(res.model.models["global"].coefficients.means)
+
+    # New data from a different seed; heavy prior keeps the fixed effect
+    # near the previous model, no prior lets it drift further.
+    train2 = _game_data(seed=41)
+    res_free = GameEstimator(_game_config()).fit(train2)[0]
+    res_prior = GameEstimator(_game_config(
+        warm_start_model_dir=str(tmp_path / "m0"),
+        use_warm_start_as_prior=True,
+        prior_weight=200.0,
+    )).fit(train2)[0]
+    d_free = np.linalg.norm(
+        np.asarray(res_free.model.models["global"].coefficients.means)
+        - w_prev)
+    d_prior = np.linalg.norm(
+        np.asarray(res_prior.model.models["global"].coefficients.means)
+        - w_prev)
+    assert d_prior < d_free * 0.5
+
+
+def test_variance_export_roundtrip(tmp_path):
+    train = _game_data()
+    cfg = _game_config()
+    cfg.coordinates[0].optimizer.variance_type = (
+        VarianceComputationType.SIMPLE)
+    cfg.coordinates[1].optimizer.variance_type = (
+        VarianceComputationType.SIMPLE)
+    res = GameEstimator(cfg).fit(train)[0]
+    fixed = res.model.models["global"]
+    re = res.model.models["per_user"]
+    assert fixed.coefficients.variances is not None
+    assert np.all(np.asarray(fixed.coefficients.variances) > 0)
+    assert re.variance_blocks is not None
+
+    save_game_model(res.model, TaskType.LOGISTIC_REGRESSION,
+                    str(tmp_path / "m"))
+    loaded, _ = load_game_model(str(tmp_path / "m"))
+    np.testing.assert_allclose(
+        np.asarray(loaded.models["global"].coefficients.variances),
+        np.asarray(fixed.coefficients.variances), rtol=1e-6)
+    assert loaded.models["per_user"].variance_blocks is not None
+
+
+def test_checkpoint_resume_matches_uninterrupted(tmp_path):
+    train = _game_data()
+    cfg_full = _game_config(n_iterations=3,
+                            checkpoint_dir=str(tmp_path / "ck_full"))
+    res_full = GameEstimator(cfg_full).fit(train)[0]
+
+    # "Preempted" run: 2 iterations checkpointed, then resume to 3.
+    cfg_a = _game_config(n_iterations=2,
+                         checkpoint_dir=str(tmp_path / "ck"))
+    GameEstimator(cfg_a).fit(train)
+    it, _ = load_latest_checkpoint(str(tmp_path / "ck"))
+    assert it == 2
+    cfg_b = _game_config(n_iterations=3,
+                         checkpoint_dir=str(tmp_path / "ck"), resume=True)
+    res_b = GameEstimator(cfg_b).fit(train)[0]
+
+    np.testing.assert_allclose(
+        np.asarray(res_b.model.models["global"].coefficients.means),
+        np.asarray(res_full.model.models["global"].coefficients.means),
+        atol=1e-4,
+    )
