@@ -147,8 +147,16 @@ def _run(config: TrainingConfig, log: RunLogger) -> dict:
               n_valid=(valid.n if valid is not None else 0))
 
     estimator = GameEstimator(config)
-    with log.timed("fit"):
-        results = estimator.fit(train, validation=valid, run_logger=log)
+    if config.tuning is not None:
+        if valid is None:
+            raise ValueError(
+                "hyperparameter tuning needs validation data "
+                "(validation_path or validation_fraction)")
+        with log.timed("fit", mode="tuning", trials=config.tuning.n_trials):
+            results = estimator.fit_tuned(train, valid, run_logger=log)
+    else:
+        with log.timed("fit"):
+            results = estimator.fit(train, validation=valid, run_logger=log)
     best = estimator.best(results)
 
     for i, r in enumerate(results):

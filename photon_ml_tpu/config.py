@@ -88,6 +88,31 @@ class CoordinateConfig:
 
 
 @dataclasses.dataclass
+class TuningConfig:
+    """Hyperparameter-tuning run settings (reference tuning params +
+    search-space JSON, SURVEY §2.7)."""
+
+    n_trials: int = 10
+    mode: str = "BAYESIAN"                 # BAYESIAN | RANDOM
+    # coordinate name → {"low": float, "high": float, "scale": "LOG"|"LINEAR"}
+    reg_weight_ranges: dict[str, dict] = dataclasses.field(
+        default_factory=dict
+    )
+    seed: int = 0
+
+    def validate(self) -> None:
+        if self.n_trials <= 0:
+            raise ValueError("n_trials must be positive")
+        if self.mode not in ("BAYESIAN", "RANDOM"):
+            raise ValueError("tuning mode must be BAYESIAN or RANDOM")
+        if not self.reg_weight_ranges:
+            raise ValueError("tuning needs reg_weight_ranges")
+        for name, r in self.reg_weight_ranges.items():
+            if "low" not in r or "high" not in r:
+                raise ValueError(f"range for '{name}' needs low and high")
+
+
+@dataclasses.dataclass
 class TrainingConfig:
     """Full training-run configuration (reference ``GameTrainingDriver``
     params; SURVEY §2.8)."""
@@ -112,6 +137,8 @@ class TrainingConfig:
     reg_weight_grid: dict[str, list[float]] = dataclasses.field(
         default_factory=dict
     )
+    # Bayesian/random tuning over reg weights (replaces the grid when set).
+    tuning: TuningConfig | None = None
     model_output_mode: str = "BEST"        # ALL | BEST | EXPLICIT
     warm_start_model_dir: str | None = None
     locked_coordinates: list[str] = dataclasses.field(default_factory=list)
@@ -159,6 +186,17 @@ class TrainingConfig:
                 raise ValueError(f"grid entry '{name}' unknown")
             if not grid:
                 raise ValueError(f"empty grid for '{name}'")
+        if self.tuning is not None:
+            self.tuning.validate()
+            if self.reg_weight_grid:
+                raise ValueError("tuning and reg_weight_grid are exclusive")
+            if self.checkpoint_dir:
+                raise ValueError("tuning does not support checkpoint_dir")
+            if not self.evaluators:
+                raise ValueError("tuning needs at least one evaluator")
+            for name in self.tuning.reg_weight_ranges:
+                if name not in names:
+                    raise ValueError(f"tuning range '{name}' unknown")
 
 
 @dataclasses.dataclass
@@ -242,6 +280,8 @@ def _coerce(type_str, v):
                     return enum_cls[v]
     if "OptimizerSettings" in t and isinstance(v, dict):
         return _build(OptimizerSettings, v)
+    if "TuningConfig" in t and isinstance(v, dict):
+        return _build(TuningConfig, v)
     return v
 
 

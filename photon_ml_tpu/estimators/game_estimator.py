@@ -404,51 +404,104 @@ class GameEstimator:
             out[ev] = float(evaluate(ev, scores, labels, weights))
         return out
 
+    def _fit_point(self, train: GameDataset, prep: dict, reg_weights: dict,
+                   validation: GameDataset | None, run_logger,
+                   ckpt_tag: str | None = None) -> FitResult:
+        """One full coordinate-descent fit at fixed λ per coordinate."""
+        cfg = self.config
+        coords = self._build_coordinates(train, prep, reg_weights)
+        logger.info("fit: point %s", reg_weights or "(default)")
+
+        warm = self._warm_coefficients(coords, prep)
+        locked = {name: warm[name] for name in cfg.locked_coordinates
+                  if name in warm}
+        missing = set(cfg.locked_coordinates) - set(locked)
+        if missing:
+            raise ValueError(
+                f"locked coordinates {sorted(missing)} absent from "
+                "the warm-start model")
+        initial = {n: w for n, w in warm.items() if n not in locked}
+
+        ckpt_dir = cfg.checkpoint_dir
+        if ckpt_dir and ckpt_tag:
+            ckpt_dir = f"{ckpt_dir}/{ckpt_tag}"
+        cd = run_coordinate_descent(
+            coordinates=coords,
+            update_sequence=cfg.update_sequence,
+            n_iterations=cfg.n_iterations,
+            locked_coordinates=locked,
+            initial_coefficients=initial,
+            checkpoint_dir=ckpt_dir,
+            resume=cfg.resume,
+            run_logger=run_logger,
+        )
+        model = self._to_game_model(coords, cd)
+        evals = (self._evaluate(model, validation)
+                 if validation is not None else {})
+        return FitResult(
+            model=model, evaluations=evals,
+            reg_weights={c.name: reg_weights.get(
+                c.name, c.optimizer.reg_weight)
+                for c in cfg.coordinates},
+        )
+
     def fit(self, train: GameDataset,
             validation: GameDataset | None = None,
             run_logger=None) -> list[FitResult]:
         """Train once per grid point; returns results in grid order."""
-        cfg = self.config
         prep = self._prepare(train)
         grid_points = self._grid_points()
-        results = []
-        for gi, reg_weights in enumerate(grid_points):
-            coords = self._build_coordinates(train, prep, reg_weights)
-            logger.info("fit: grid point %s", reg_weights or "(default)")
-
-            warm = self._warm_coefficients(coords, prep)
-            locked = {name: warm[name] for name in cfg.locked_coordinates
-                      if name in warm}
-            missing = set(cfg.locked_coordinates) - set(locked)
-            if missing:
-                raise ValueError(
-                    f"locked coordinates {sorted(missing)} absent from "
-                    "the warm-start model")
-            initial = {n: w for n, w in warm.items() if n not in locked}
-
-            ckpt_dir = cfg.checkpoint_dir
-            if ckpt_dir and len(grid_points) > 1:
-                ckpt_dir = f"{ckpt_dir}/grid_{gi}"
-            cd = run_coordinate_descent(
-                coordinates=coords,
-                update_sequence=cfg.update_sequence,
-                n_iterations=cfg.n_iterations,
-                locked_coordinates=locked,
-                initial_coefficients=initial,
-                checkpoint_dir=ckpt_dir,
-                resume=cfg.resume,
-                run_logger=run_logger,
+        return [
+            self._fit_point(
+                train, prep, reg_weights, validation, run_logger,
+                ckpt_tag=(f"grid_{gi}" if len(grid_points) > 1 else None),
             )
-            model = self._to_game_model(coords, cd)
-            evals = (self._evaluate(model, validation)
-                     if validation is not None else {})
-            results.append(FitResult(
-                model=model, evaluations=evals,
-                reg_weights={c.name: reg_weights.get(
-                    c.name, c.optimizer.reg_weight)
-                    for c in cfg.coordinates},
-            ))
-        return results
+            for gi, reg_weights in enumerate(grid_points)
+        ]
+
+    def fit_tuned(self, train: GameDataset, validation: GameDataset,
+                  run_logger=None) -> list[FitResult]:
+        """Bayesian/random tuning of per-coordinate reg weights
+        (reference HyperparameterTuner wrapping GameEstimator.fit,
+        SURVEY §3.5).  Returns one FitResult per trial, in trial order."""
+        from photon_ml_tpu.hyperparameter import (
+            HyperparameterTuner,
+            ParamRange,
+            ParamScale,
+            SearchSpace,
+            TunerMode,
+        )
+
+        cfg = self.config
+        tuning = cfg.tuning
+        if tuning is None:
+            raise ValueError("fit_tuned requires config.tuning")
+        if not cfg.evaluators:
+            raise ValueError("tuning needs at least one evaluator")
+        ev = cfg.evaluators[0]
+
+        space = SearchSpace([
+            ParamRange(name, r["low"], r["high"],
+                       ParamScale(r.get("scale", "LOG")))
+            for name, r in sorted(tuning.reg_weight_ranges.items())
+        ])
+        prep = self._prepare(train)
+
+        def evaluate_fn(point: dict):
+            result = self._fit_point(
+                train, prep, dict(point), validation, run_logger,
+                ckpt_tag=None)
+            return result.evaluations[ev], result
+
+        tuner = HyperparameterTuner(
+            space,
+            mode=TunerMode(tuning.mode),
+            larger_is_better=ev.larger_is_better,
+            seed=tuning.seed,
+        )
+        trials = tuner.run(evaluate_fn, tuning.n_trials,
+                           run_logger=run_logger)
+        return [t.payload for t in trials]
 
     def best(self, results: list[FitResult]) -> FitResult:
         """Model selection by the first evaluator (reference rule)."""

@@ -1,0 +1,28 @@
+"""Bayesian hyperparameter tuning: GP regression + Expected Improvement.
+
+Reference: photon-lib ``com.linkedin.photon.ml.hyperparameter``
+(SURVEY.md §2.7 — expected paths, mount unavailable).
+"""
+
+from photon_ml_tpu.hyperparameter.gp import GaussianProcessModel, fit_gp
+from photon_ml_tpu.hyperparameter.kernels import KernelType
+from photon_ml_tpu.hyperparameter.search import (
+    GaussianProcessSearch,
+    ParamRange,
+    ParamScale,
+    RandomSearch,
+    SearchSpace,
+    expected_improvement,
+)
+from photon_ml_tpu.hyperparameter.tuner import (
+    HyperparameterTuner,
+    TrialResult,
+    TunerMode,
+)
+
+__all__ = [
+    "GaussianProcessModel", "fit_gp", "KernelType",
+    "GaussianProcessSearch", "ParamRange", "ParamScale", "RandomSearch",
+    "SearchSpace", "expected_improvement",
+    "HyperparameterTuner", "TrialResult", "TunerMode",
+]

@@ -1,0 +1,99 @@
+"""Gaussian-process regression over observed (config, evaluation) pairs.
+
+Reference counterparts: ``GaussianProcessEstimator`` /
+``GaussianProcessModel`` (photon-lib
+``com.linkedin.photon.ml.hyperparameter.estimators`` [expected paths,
+mount unavailable — see SURVEY.md §2.7]).
+
+Exact GP with Cholesky solves — tuning histories are tens of points, so
+the O(n³) factorization is trivial; everything is jittable jnp so the
+posterior over thousands of candidate points is one fused device
+program.  Kernel hyperparameters are chosen by maximizing the log
+marginal likelihood over a small multi-start grid (the reference
+similarly refits per observation round).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.hyperparameter.kernels import (
+    KernelType,
+    kernel_fn,
+)
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class GaussianProcessModel:
+    """Posterior state: predict mean/std at new points."""
+
+    x_train: Array          # [n, d] rescaled observations
+    chol: Array             # [n, n] Cholesky of K + σ_n² I
+    alpha: Array            # [n] (K + σ_n² I)⁻¹ (y − μ)
+    y_mean: Array           # scalar target mean (centering)
+    kind: KernelType
+    amplitude: float
+    lengthscale: float
+    noise: float
+
+    def predict(self, x: Array) -> tuple[Array, Array]:
+        """Posterior (mean, std) at [m, d] candidate points."""
+        k = kernel_fn(self.kind)
+        k_star = k(self.x_train, x, self.amplitude, self.lengthscale)
+        mean = self.y_mean + k_star.T @ self.alpha
+        v = jax.scipy.linalg.solve_triangular(self.chol, k_star, lower=True)
+        prior_var = self.amplitude**2
+        var = jnp.maximum(prior_var - jnp.sum(v * v, axis=0), 1e-12)
+        return mean, jnp.sqrt(var)
+
+
+def _fit_fixed(x: Array, y: Array, kind: KernelType, amplitude,
+               lengthscale, noise):
+    k = kernel_fn(kind)
+    n = x.shape[0]
+    y_mean = jnp.mean(y)
+    yc = y - y_mean
+    gram = k(x, x, amplitude, lengthscale) + (noise**2 + 1e-8) * jnp.eye(n)
+    chol = jnp.linalg.cholesky(gram)
+    alpha = jax.scipy.linalg.cho_solve((chol, True), yc)
+    # log marginal likelihood (up to constant)
+    lml = (-0.5 * jnp.vdot(yc, alpha)
+           - jnp.sum(jnp.log(jnp.diagonal(chol)))
+           - 0.5 * n * jnp.log(2.0 * jnp.pi))
+    return chol, alpha, y_mean, lml
+
+
+def fit_gp(
+    x: Array,
+    y: Array,
+    kind: KernelType = KernelType.MATERN52,
+    lengthscales=(0.1, 0.2, 0.4, 0.8),
+    noises=(1e-3, 1e-2, 1e-1),
+) -> GaussianProcessModel:
+    """Fit by marginal-likelihood model selection over a small grid.
+
+    Amplitude is set to std(y) (empirical-Bayes scaling); lengthscale
+    and noise are chosen by LML over the grid — robust at the <100-point
+    scale of tuning runs, with no risk of gradient-ascent divergence.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    amplitude = float(jnp.std(y)) or 1.0
+
+    best = None
+    for ls in lengthscales:
+        for nz in noises:
+            chol, alpha, y_mean, lml = _fit_fixed(
+                x, y, kind, amplitude, ls, nz)
+            if best is None or float(lml) > best[0]:
+                best = (float(lml), chol, alpha, y_mean, ls, nz)
+    _, chol, alpha, y_mean, ls, nz = best
+    return GaussianProcessModel(
+        x_train=x, chol=chol, alpha=alpha, y_mean=y_mean, kind=kind,
+        amplitude=amplitude, lengthscale=ls, noise=nz,
+    )

@@ -1,0 +1,58 @@
+"""Stationary GP kernels: RBF and Matérn-5/2.
+
+Reference counterparts: ``StationaryKernel``, ``RBF``, ``Matern52``
+(photon-lib ``com.linkedin.photon.ml.hyperparameter.estimators.kernels``
+[expected paths, mount unavailable — see SURVEY.md §2.7]).
+
+Kernels are pure jittable functions over [n, d] point sets in the
+rescaled [0, 1]^d search space; hyperparameters (amplitude, per-dim
+lengthscales, noise) are explicit arguments so marginal-likelihood
+optimization can differentiate through them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class KernelType(str, enum.Enum):
+    RBF = "RBF"
+    MATERN52 = "MATERN52"
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelParams:
+    amplitude: float = 1.0       # signal variance σ_f²  (stored as σ_f)
+    lengthscale: float = 0.25    # isotropic ℓ in the rescaled space
+    noise: float = 1e-4          # observation noise σ_n² (stored as σ_n)
+
+
+def _sq_dists(x1: Array, x2: Array, lengthscale) -> Array:
+    """Pairwise squared distances of ℓ-scaled points: [n1, n2]."""
+    a = x1 / lengthscale
+    b = x2 / lengthscale
+    aa = jnp.sum(a * a, axis=-1)[:, None]
+    bb = jnp.sum(b * b, axis=-1)[None, :]
+    return jnp.maximum(aa + bb - 2.0 * (a @ b.T), 0.0)
+
+
+def rbf(x1: Array, x2: Array, amplitude, lengthscale) -> Array:
+    r2 = _sq_dists(x1, x2, lengthscale)
+    return amplitude**2 * jnp.exp(-0.5 * r2)
+
+
+def matern52(x1: Array, x2: Array, amplitude, lengthscale) -> Array:
+    r2 = _sq_dists(x1, x2, lengthscale)
+    r = jnp.sqrt(r2 + 1e-12)
+    s5r = jnp.sqrt(5.0) * r
+    return amplitude**2 * (1.0 + s5r + 5.0 * r2 / 3.0) * jnp.exp(-s5r)
+
+
+def kernel_fn(kind: KernelType):
+    return rbf if kind == KernelType.RBF else matern52

@@ -1,0 +1,157 @@
+"""Hyperparameter search: rescaling, Expected Improvement, strategies.
+
+Reference counterparts: ``VectorRescaling``, ``ExpectedImprovement``,
+``RandomSearch``, ``GaussianProcessSearch`` (photon-lib
+``com.linkedin.photon.ml.hyperparameter.search`` [expected paths, mount
+unavailable — see SURVEY.md §2.7/§3.5]).
+
+The search space is a box over named parameters, each linear- or
+log-scaled into [0, 1] (the reference's rescaling).  ``RandomSearch``
+proposes quasi-uniform points; ``GaussianProcessSearch`` fits a GP to
+the observation history and proposes the EI-argmax over a random
+candidate sweep (the reference samples candidates the same way).
+Metrics where smaller is better (RMSE, losses) are negated internally
+so the acquisition always maximizes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.hyperparameter.gp import fit_gp
+from photon_ml_tpu.hyperparameter.kernels import KernelType
+
+Array = jax.Array
+
+
+class ParamScale(str, enum.Enum):
+    LINEAR = "LINEAR"
+    LOG = "LOG"
+
+
+@dataclasses.dataclass
+class ParamRange:
+    """One tunable dimension (reference search-space JSON entry)."""
+
+    name: str
+    low: float
+    high: float
+    scale: ParamScale = ParamScale.LOG
+
+    def validate(self) -> None:
+        if not self.low < self.high:
+            raise ValueError(f"{self.name}: low must be < high")
+        if self.scale == ParamScale.LOG and self.low <= 0:
+            raise ValueError(f"{self.name}: LOG scale needs low > 0")
+
+    def to_unit(self, v: float) -> float:
+        if self.scale == ParamScale.LOG:
+            return (math.log(v) - math.log(self.low)) / (
+                math.log(self.high) - math.log(self.low))
+        return (v - self.low) / (self.high - self.low)
+
+    def from_unit(self, u: float) -> float:
+        u = min(max(u, 0.0), 1.0)
+        if self.scale == ParamScale.LOG:
+            return math.exp(
+                math.log(self.low)
+                + u * (math.log(self.high) - math.log(self.low)))
+        return self.low + u * (self.high - self.low)
+
+
+@dataclasses.dataclass
+class SearchSpace:
+    """Named box; converts between config dicts and unit vectors."""
+
+    params: list[ParamRange]
+
+    def __post_init__(self):
+        for p in self.params:
+            p.validate()
+
+    @property
+    def dim(self) -> int:
+        return len(self.params)
+
+    def to_unit(self, config: dict) -> np.ndarray:
+        return np.asarray([p.to_unit(config[p.name]) for p in self.params],
+                          np.float32)
+
+    def from_unit(self, u: np.ndarray) -> dict:
+        return {p.name: p.from_unit(float(u[i]))
+                for i, p in enumerate(self.params)}
+
+
+def expected_improvement(mean: Array, std: Array, best: Array) -> Array:
+    """EI for maximization: E[max(f − best, 0)] under N(mean, std²)."""
+    z = (mean - best) / std
+    cdf = 0.5 * (1.0 + jax.scipy.special.erf(z / jnp.sqrt(2.0)))
+    pdf = jnp.exp(-0.5 * z * z) / jnp.sqrt(2.0 * jnp.pi)
+    return (mean - best) * cdf + std * pdf
+
+
+class RandomSearch:
+    """Quasi-uniform proposals (reference ``RandomSearch``)."""
+
+    def __init__(self, space: SearchSpace, seed: int = 0):
+        self.space = space
+        self._rng = np.random.default_rng(seed)
+
+    def propose(self, history: list) -> dict:
+        return self.space.from_unit(self._rng.uniform(size=self.space.dim))
+
+
+class GaussianProcessSearch:
+    """GP + EI proposals (reference ``GaussianProcessSearch``).
+
+    ``history`` is a list of (config dict, metric); ``larger_is_better``
+    flips loss-like metrics.  Falls back to random proposals until
+    ``min_observations`` are available (the reference seeds the GP the
+    same way).
+    """
+
+    def __init__(
+        self,
+        space: SearchSpace,
+        larger_is_better: bool = True,
+        kernel: KernelType = KernelType.MATERN52,
+        n_candidates: int = 2048,
+        min_observations: int = 3,
+        seed: int = 0,
+    ):
+        self.space = space
+        self.larger_is_better = larger_is_better
+        self.kernel = kernel
+        self.n_candidates = n_candidates
+        self.min_observations = min_observations
+        self._rng = np.random.default_rng(seed)
+        self._random = RandomSearch(space, seed=seed + 1)
+
+    def propose(self, history: list) -> dict:
+        if len(history) < self.min_observations:
+            return self._random.propose(history)
+        x = np.stack([self.space.to_unit(cfg) for cfg, _ in history])
+        y = np.asarray([m for _, m in history], np.float32)
+        if not self.larger_is_better:
+            y = -y
+        gp = fit_gp(jnp.asarray(x), jnp.asarray(y), kind=self.kernel)
+        cands = self._rng.uniform(
+            size=(self.n_candidates, self.space.dim)).astype(np.float32)
+        # Local refinement around the incumbent (reference slice-sample
+        # spirit): half the candidates perturb the best-so-far point.
+        best_x = x[int(np.argmax(y))]
+        local = np.clip(
+            best_x + 0.1 * self._rng.normal(
+                size=(self.n_candidates // 2, self.space.dim)),
+            0.0, 1.0,
+        ).astype(np.float32)
+        cands = np.vstack([cands, local])
+        mean, std = gp.predict(jnp.asarray(cands))
+        ei = expected_improvement(mean, std, jnp.max(jnp.asarray(y)))
+        return self.space.from_unit(cands[int(jnp.argmax(ei))])

@@ -1,0 +1,74 @@
+"""Hyperparameter tuner entry: iterate (propose → fit → observe).
+
+Reference counterparts: ``HyperparameterTuner`` /
+``HyperparameterTunerFactory`` (photon-lib
+``com.linkedin.photon.ml.hyperparameter.tuner`` [expected paths, mount
+unavailable — see SURVEY.md §2.7/§3.5]): the tuning loop wraps the full
+``GameEstimator.fit`` — each trial trains a model with the proposed
+configuration and reports the validation metric back to the search.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+from photon_ml_tpu.hyperparameter.search import (
+    GaussianProcessSearch,
+    RandomSearch,
+    SearchSpace,
+)
+
+
+class TunerMode(str, enum.Enum):
+    RANDOM = "RANDOM"
+    BAYESIAN = "BAYESIAN"
+
+
+@dataclasses.dataclass
+class TrialResult:
+    config: dict     # parameter name → value
+    metric: float
+    payload: object  # whatever evaluate_fn returned alongside the metric
+
+
+class HyperparameterTuner:
+    """Drive n trials of ``evaluate_fn`` over a search space.
+
+    ``evaluate_fn(config) → (metric, payload)`` — typically a full GAME
+    fit returning (validation metric, FitResult).
+    """
+
+    def __init__(
+        self,
+        space: SearchSpace,
+        mode: TunerMode = TunerMode.BAYESIAN,
+        larger_is_better: bool = True,
+        seed: int = 0,
+    ):
+        self.space = space
+        self.larger_is_better = larger_is_better
+        if mode == TunerMode.RANDOM:
+            self.search = RandomSearch(space, seed=seed)
+        else:
+            self.search = GaussianProcessSearch(
+                space, larger_is_better=larger_is_better, seed=seed)
+
+    def run(self, evaluate_fn, n_trials: int,
+            run_logger=None) -> list[TrialResult]:
+        history: list = []
+        trials: list[TrialResult] = []
+        for t in range(n_trials):
+            config = self.search.propose(history)
+            metric, payload = evaluate_fn(config)
+            history.append((config, metric))
+            trials.append(TrialResult(config=config, metric=float(metric),
+                                      payload=payload))
+            if run_logger is not None:
+                run_logger.event("tuning_trial", trial=t, config=config,
+                                 metric=float(metric))
+        return trials
+
+    def best(self, trials: list[TrialResult]) -> TrialResult:
+        key = (max if self.larger_is_better else min)
+        return key(trials, key=lambda t: t.metric)
