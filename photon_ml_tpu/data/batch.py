@@ -35,6 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from photon_ml_tpu.data.colmajor import ColMajorSlice, build_colmajor
+
 Array = jax.Array
 
 
@@ -76,6 +78,13 @@ class SparseBatch:
     ``col_ids`` padding entries point at column 0 with ``values`` 0.0 so
     gathers stay in-bounds and scatters add zero; correctness never depends
     on the padding target.
+
+    ``colmajor`` optionally carries the transposed-ELL copy of the same
+    nonzeros (``data.colmajor``); when present, ``xt_dot`` — the gradient
+    contraction Xᵀr — runs scatter-free (gather + row-sum + tiny fold)
+    instead of a full-size ``segment_sum``, which on TPU is the
+    difference between ~1 GB/s and near-roofline HBM bandwidth.  Build it
+    with ``make_sparse_batch(..., col_major=True)``.
     """
 
     values: Array     # [n, k] float
@@ -85,6 +94,7 @@ class SparseBatch:
     offsets: Array    # [n] float
     mask: Array       # [n] float
     dim: int = struct.field(pytree_node=False)
+    colmajor: "ColMajorSlice | None" = None
 
     @property
     def n_padded(self) -> int:
@@ -92,10 +102,15 @@ class SparseBatch:
 
     def margins(self, w: Array) -> Array:
         """Σ_k values[i,k]·w[col_ids[i,k]] + offset — gather + row reduce."""
-        return jnp.sum(self.values * w[self.col_ids], axis=-1) + self.offsets
+        from photon_ml_tpu.ops.kernels import gather_rowsum
+
+        return gather_rowsum(w, self.values, self.col_ids) + self.offsets
 
     def xt_dot(self, r: Array) -> Array:
-        """X^T r via segment-sum scatter-add into the [dim] gradient."""
+        """X^T r: transposed gather+rowsum when ``colmajor`` is present,
+        else a segment-sum scatter-add into the [dim] gradient."""
+        if self.colmajor is not None:
+            return self.colmajor.xt_dot(r)
         contrib = self.values * r[:, None]            # [n, k]
         return jax.ops.segment_sum(
             contrib.reshape(-1),
@@ -104,7 +119,9 @@ class SparseBatch:
         )
 
     def x_dot(self, v: Array) -> Array:
-        return jnp.sum(self.values * v[self.col_ids], axis=-1)
+        from photon_ml_tpu.ops.kernels import gather_rowsum
+
+        return gather_rowsum(v, self.values, self.col_ids)
 
     def to_dense(self) -> DenseBatch:
         """Densify (testing / small-dim fast path)."""
@@ -159,6 +176,8 @@ def make_sparse_batch(
     row_capacity: int | None = None,
     pad_to: int | None = None,
     dtype=jnp.float32,
+    col_major: bool = False,
+    col_capacity: int | None = None,
 ) -> SparseBatch:
     """Build a padded-ELL SparseBatch.
 
@@ -167,6 +186,11 @@ def make_sparse_batch(
       dim: feature-space width (static).
       row_capacity: per-row nnz capacity; defaults to the max observed.
       pad_to: pad the example count to this (e.g. a multiple of shard count).
+      col_major: also build the transposed-ELL copy so gradients run
+        scatter-free (see ``data.colmajor``; costs one extra copy of the
+        nonzeros in HBM — worth it whenever the batch is iterated on).
+      col_capacity: virtual-row capacity for the transpose (default:
+        auto from the column-occupancy distribution).
     """
     n = len(rows)
     k = row_capacity or max((len(c) for c, _ in rows), default=1)
@@ -197,6 +221,11 @@ def make_sparse_batch(
     off[:n] = offsets
     mask = np.zeros(n_out)
     mask[:n] = 1.0
+    cm = (
+        build_colmajor(cols, vals, dim, capacity=col_capacity)
+        if col_major
+        else None
+    )
     return SparseBatch(
         values=jnp.asarray(vals, dtype),
         col_ids=jnp.asarray(cols),
@@ -205,4 +234,5 @@ def make_sparse_batch(
         offsets=jnp.asarray(off, dtype),
         mask=jnp.asarray(mask, dtype),
         dim=dim,
+        colmajor=cm,
     )

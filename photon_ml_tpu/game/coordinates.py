@@ -91,7 +91,16 @@ class FixedEffectCoordinate(Coordinate):
     def _training_batch(self, offsets: Array) -> Batch:
         if self.train_idx is None:
             return self.batch.replace(offsets=offsets)
-        sub = jax.tree.map(lambda a: a[self.train_idx], self.batch)
+        base = self.batch
+        from photon_ml_tpu.data.batch import SparseBatch
+
+        if isinstance(base, SparseBatch) and base.colmajor is not None:
+            # The transposed-ELL copy indexes *all* rows; subsetting its
+            # virtual-row arrays by example ids would silently corrupt
+            # X^T r.  Drop it — the subsetted batch falls back to the
+            # segment-sum path (down-sampled solves are smaller anyway).
+            base = base.replace(colmajor=None)
+        sub = jax.tree.map(lambda a: a[self.train_idx], base)
         return sub.replace(offsets=offsets[self.train_idx],
                            weights=self.train_weights)
 

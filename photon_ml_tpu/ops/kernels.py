@@ -1,0 +1,114 @@
+"""The sparse hot-op: ``rowsum(vals · table[ids])`` — XLA and Pallas paths.
+
+Both directions of the sparse GLM hot loop are instances of one
+gather-contract primitive over a padded-ELL tile:
+
+- margins:   ``m[i] = Σ_k values[i,k] · w[col_ids[i,k]]``     (table = w)
+- gradient:  ``p[v] = Σ_k tvals[v,k] · r[trows[v,k]]``         (table = r,
+  over the transposed layout — see ``data.colmajor``)
+
+Reference counterpart: the per-example fold inside
+``ValueAndGradientAggregator`` (photon-lib
+``com.linkedin.photon.ml.function.glm`` [expected path, mount unavailable
+— SURVEY.md §2.2]).  The reference's hot loop is scalar JVM code over
+Breeze sparse vectors; here it is one vectorized gather+multiply+reduce,
+and on TPU a Pallas kernel that keeps the gather table resident in VMEM
+and streams ELL tiles HBM→VMEM, so each nonzero costs ~8 bytes of HBM
+traffic and no scatter ever happens (design rationale in
+``data/colmajor.py``).
+
+Dispatch:
+- TPU backend + aligned shapes + table fits VMEM → Pallas kernel.
+- anything else (CPU tests, virtual meshes, odd shapes) → pure-XLA
+  ``jnp.sum(vals * table[ids], -1)``, which XLA fuses well everywhere
+  except the TPU gather (the thing the kernel exists to fix).
+- ``PHOTON_ML_TPU_PALLAS=0|1`` forces the choice (0 is the escape hatch
+  if a jax/libtpu regression breaks the kernel; 1 + interpret mode is
+  how CPU tests exercise the kernel body).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# Tables larger than this stay on the XLA path: the kernel holds the full
+# gather table in VMEM (~16 MB/core on v5e) alongside double-buffered ELL
+# tiles.  8 MB ≈ a 2M-row f32 table — covers w up to d=2M and residuals
+# up to n=2M per device shard; beyond that, shard the batch.
+_MAX_TABLE_BYTES = 8 * 1024 * 1024
+
+
+def _want_pallas() -> bool:
+    env = os.environ.get("PHOTON_ML_TPU_PALLAS")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _xla_gather_rowsum(table: Array, vals: Array, ids: Array) -> Array:
+    return jnp.sum(vals * table[ids], axis=-1)
+
+
+def _row_tile(capacity: int, n_rows: int) -> int:
+    """Rows per grid step: target ~64k elements per (vals, ids) tile so
+    two tiles double-buffer comfortably under the VMEM budget, clamped
+    to the row count (tiny batches = one grid step)."""
+    t = max(8, (65536 // max(capacity, 1)) // 8 * 8)
+    return min(t, max(8, n_rows // 8 * 8))
+
+
+def _pallas_gather_rowsum(table: Array, vals: Array, ids: Array,
+                          interpret: bool = False) -> Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, k = vals.shape
+    tile = _row_tile(k, n)
+    grid = n // tile
+
+    def kernel(table_ref, vals_ref, ids_ref, out_ref):
+        gathered = table_ref[ids_ref[:]]          # [tile, k] VMEM gather
+        out_ref[:] = jnp.sum(vals_ref[:] * gathered, axis=-1)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),            # full table
+            pl.BlockSpec((tile, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile,), lambda i: (i,),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n,), vals.dtype),
+        interpret=interpret,
+    )(table, vals, ids)
+
+
+def gather_rowsum(table: Array, vals: Array, ids: Array) -> Array:
+    """``out[i] = Σ_k vals[i,k] · table[ids[i,k]]`` with TPU dispatch.
+
+    Args:
+      table: [L] float — the gather table (w for margins, r for Xᵀr).
+      vals:  [n, k] float — ELL values (padding slots are 0).
+      ids:   [n, k] int32 — ELL indices into ``table`` (padding → 0).
+    """
+    n, k = vals.shape
+    if (
+        _want_pallas()
+        and table.ndim == 1
+        and table.size * table.dtype.itemsize <= _MAX_TABLE_BYTES
+        and n % _row_tile(k, n) == 0
+    ):
+        return _pallas_gather_rowsum(table, vals, ids)
+    return _xla_gather_rowsum(table, vals, ids)

@@ -165,7 +165,8 @@ def _elementwise_square_batch(batch: Batch) -> Batch:
     if isinstance(batch, DenseBatch):
         return batch.replace(x=batch.x * batch.x)
     assert isinstance(batch, SparseBatch)
-    return batch.replace(values=batch.values * batch.values)
+    cm = batch.colmajor.squared() if batch.colmajor is not None else None
+    return batch.replace(values=batch.values * batch.values, colmajor=cm)
 
 
 class ObjectiveFns(NamedTuple):

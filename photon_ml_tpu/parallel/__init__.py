@@ -13,6 +13,7 @@ from photon_ml_tpu.parallel.mesh import (
     padded_rows,
     replicate,
     shard_batch,
+    shard_sparse_batch,
 )
 
 __all__ = [
@@ -24,4 +25,5 @@ __all__ = [
     "padded_rows",
     "replicate",
     "shard_batch",
+    "shard_sparse_batch",
 ]

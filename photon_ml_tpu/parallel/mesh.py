@@ -60,6 +60,15 @@ def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
     imbalance costs nothing but the pad FLOPs.  This is the rebuild's
     "shuffle": it happens once, before training, not per-iteration.
     """
+    from photon_ml_tpu.data.batch import SparseBatch
+
+    if isinstance(batch, SparseBatch) and batch.colmajor is not None:
+        raise ValueError(
+            "cannot shard a SparseBatch whose colmajor transpose was "
+            "built globally: trows index the whole batch, but each "
+            "device shard sees only its local residuals.  Build with "
+            "shard_sparse_batch(...) instead (per-shard transposes)."
+        )
     n = batch.n_padded
     n_dev = mesh.devices.size
     if n % n_dev != 0:
@@ -69,6 +78,101 @@ def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
         )
     sharding = NamedSharding(mesh, batch_spec())
     return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+
+
+def shard_sparse_batch(
+    rows,
+    dim: int,
+    labels: np.ndarray,
+    mesh: Mesh,
+    weights: np.ndarray | None = None,
+    offsets: np.ndarray | None = None,
+    row_capacity: int | None = None,
+    col_major: bool = True,
+    col_capacity: int | None = None,
+):
+    """Host-side ETL: split examples across the mesh, build one
+    SparseBatch per device — each with the transposed-ELL copy of *its
+    own* rows (shard-local ``trows``) — and assemble the global
+    example-sharded arrays.
+
+    This is the rebuild of the reference's one-time ``partitionBy``
+    shuffle (SURVEY.md §5.8): after this call every optimizer iteration
+    is pure compute + one ``psum``; no per-step data movement.  The
+    per-shard transpose is what keeps the gradient contraction
+    scatter-free under data parallelism: each device computes
+    ``Xᵀ_shard r_shard`` locally (gather+rowsum over local rows), and the
+    partial [dim] gradients are combined by the same ``psum`` that
+    already reduces the loss.
+    """
+    from photon_ml_tpu.data.batch import make_sparse_batch
+    from photon_ml_tpu.data.colmajor import build_colmajor, choose_capacity
+
+    n = len(labels)
+    n_dev = mesh.devices.size
+    per = padded_rows(n, n_dev) // n_dev
+    k = row_capacity or max((len(c) for c, _ in rows), default=1)
+
+    weights = np.ones(n) if weights is None else np.asarray(weights)
+    offsets = np.zeros(n) if offsets is None else np.asarray(offsets)
+
+    shards = []
+    for i in range(n_dev):
+        lo, hi = i * per, min((i + 1) * per, n)
+        shards.append(
+            make_sparse_batch(
+                rows[lo:hi],
+                dim,
+                np.asarray(labels)[lo:hi],
+                weights=weights[lo:hi],
+                offsets=offsets[lo:hi],
+                row_capacity=k,
+                pad_to=per,
+            )
+        )
+
+    if col_major:
+        if col_capacity is None:
+            counts = np.bincount(
+                np.concatenate([np.asarray(c) for c, _ in rows])
+                if rows else np.zeros(0, np.int64),
+                minlength=dim,
+            )
+            col_capacity = choose_capacity(counts)
+        # Per-shard virtual-row counts (cheap bincounts) → common padded
+        # shape, so build_colmajor emits equal-shape shards directly.
+        shard_counts = [
+            np.bincount(
+                np.asarray(b.col_ids).reshape(-1)[
+                    np.asarray(b.values).reshape(-1) != 0
+                ],
+                minlength=dim,
+            )
+            for b in shards
+        ]
+        v_max = max(
+            int((-(-c // col_capacity)).sum()) for c in shard_counts
+        )
+        v_max = max(-(-max(v_max, 1) // 8) * 8, 8)
+        shards = [
+            b.replace(colmajor=build_colmajor(
+                np.asarray(b.col_ids), np.asarray(b.values), dim,
+                capacity=col_capacity, pad_vrows_to=v_max,
+            ))
+            for b in shards
+        ]
+
+    devices = list(mesh.devices.flat)
+    sharding = NamedSharding(mesh, batch_spec())
+
+    def assemble(*leaves):
+        placed = [jax.device_put(lf, d) for lf, d in zip(leaves, devices)]
+        gshape = (n_dev * leaves[0].shape[0],) + tuple(leaves[0].shape[1:])
+        return jax.make_array_from_single_device_arrays(
+            gshape, sharding, placed
+        )
+
+    return jax.tree.map(assemble, *shards)
 
 
 def replicate(x, mesh: Mesh):
