@@ -97,8 +97,24 @@ def _run(config: ScoringConfig, log: RunLogger) -> dict:
         margins = transformer.transform(data)
     predictions = np.asarray(task.loss.mean(jnp.asarray(margins)))
 
-    np.savez(config.output_path, scores=margins, predictions=predictions,
-             labels=data.labels)
+    if config.output_path.endswith(".avro"):
+        # Reference-parity output: ScoringResultAvro records.
+        from photon_ml_tpu.io.avro import write_container
+        from photon_ml_tpu.io.avro_schemas import SCORING_RESULT_SCHEMA
+
+        write_container(
+            config.output_path,
+            SCORING_RESULT_SCHEMA,
+            ({"uid": i,
+              "predictionScore": float(predictions[i]),
+              "label": float(data.labels[i]),
+              "ids": {k: str(int(col[i]))
+                      for k, col in data.entity_ids.items()}}
+             for i in range(data.n)),
+        )
+    else:
+        np.savez(config.output_path, scores=margins,
+                 predictions=predictions, labels=data.labels)
 
     evaluation = {}
     if config.evaluators:

@@ -32,11 +32,29 @@ from photon_ml_tpu.io.index_map import IndexMap, IndexMapBuilder, feature_key
 
 
 def _iter_records(path: str):
+    """Yield structured records from JSONL or Avro (by extension/magic):
+    the two containers carry the same record shape, so everything
+    downstream (index building, ETL) is format-blind."""
+    if _is_avro(path):
+        from photon_ml_tpu.io.avro_schemas import iter_avro_dataset
+
+        yield from iter_avro_dataset(path)
+        return
     with open(path) as f:
         for line in f:
             line = line.strip()
             if line:
                 yield json.loads(line)
+
+
+def _is_avro(path: str) -> bool:
+    if path.endswith(".avro"):
+        return True
+    try:
+        with open(path, "rb") as f:
+            return f.read(4) == b"Obj\x01"
+    except OSError:
+        return False
 
 
 def _feature_entries(entries):
@@ -85,6 +103,8 @@ def detect_format(path: str, declared: str = "auto") -> str:
         return declared
     if path.endswith((".jsonl", ".json", ".ndjson")):
         return "jsonl"
+    if _is_avro(path):
+        return "avro"
     return "libsvm"
 
 

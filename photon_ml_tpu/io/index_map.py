@@ -55,6 +55,12 @@ class IndexMap:
             out[i] = k
         return out
 
+    def feature_at(self, i: int) -> tuple[str, str]:
+        """Inverse of ``get_feature``: index → (name, term)."""
+        key = self.names()[i]
+        name, sep, term = key.partition(_DELIM)
+        return (name, term) if sep else (key, "")
+
     def save(self, path: str) -> None:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as f:

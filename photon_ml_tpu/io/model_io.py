@@ -8,9 +8,11 @@ SURVEY.md §2.4/§3.1]).
 Layout: ``<dir>/metadata.json`` (task, coordinate kinds/shards) +
 ``<dir>/<coordinate>.npz`` (fixed: means/variances; random: per-bucket
 coefficient blocks + the entity-level grouping index + projection
-feature ids).  npz+json is the environment's honest stand-in for Avro
-(no Avro lib baked in); the schema carries the same fields as
-``BayesianLinearModelAvro`` (means, variances, feature index mapping).
+feature ids).  npz is the fast native checkpoint format (zero-copy
+arrays, exact round trip of the padded block layout); for interchange
+with reference pipelines, ``export_model_avro`` additionally writes
+per-coordinate ``BayesianLinearModelAvro`` container files keyed by
+(name, term) via the stdlib Avro codec in ``io.avro``.
 """
 
 from __future__ import annotations
@@ -133,3 +135,76 @@ def load_game_model(model_dir: str) -> tuple[GameModel, TaskType]:
                 entity_key=info.get("entity_key"),
             )
     return GameModel(models=models), task
+
+
+def export_model_avro(
+    model: GameModel,
+    task: TaskType,
+    feature_maps: dict,
+    out_dir: str,
+) -> list[str]:
+    """Write per-coordinate ``BayesianLinearModelAvro`` container files.
+
+    Reference parity (``ModelProcessingUtils.saveGameModelToHDFS``):
+    coefficients are keyed by (name, term) so the file is portable
+    across feature-index rebuilds.  Fixed effect → one record; random
+    effect → one record per entity (``modelId`` = entity id), in the
+    reference's per-entity Bayesian-linear-model layout.
+
+    ``feature_maps``: feature shard → IndexMap (must cover every shard
+    the model references; the intercept column the estimator appends is
+    emitted as name="(INTERCEPT)").
+    """
+    from photon_ml_tpu.io.avro_schemas import write_model_avro
+    from photon_ml_tpu.io.avro import write_container
+    from photon_ml_tpu.io.avro_schemas import bayesian_linear_model_schema
+
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+
+    def keyer(imap, dim):
+        def index_to_key(i):
+            if i >= len(imap):          # estimator-appended intercept
+                return ("(INTERCEPT)", "")
+            return imap.feature_at(i)
+        return index_to_key
+
+    for name, comp in model.models.items():
+        path = os.path.join(out_dir, f"{name}.avro")
+        if isinstance(comp, FixedEffectModel):
+            imap = feature_maps[comp.feature_shard]
+            means = np.asarray(comp.coefficients.means)
+            variances = (
+                None if comp.coefficients.variances is None
+                else np.asarray(comp.coefficients.variances)
+            )
+            write_model_avro(
+                path, name, means, keyer(imap, means.size),
+                variances=variances, loss_function=task.value,
+            )
+        elif isinstance(comp, RandomEffectModel):
+            imap = feature_maps[comp.feature_shard]
+
+            def records():
+                for eid in np.asarray(comp.grouping.entity_ids):
+                    w = comp.global_coefficients_for(int(eid))
+                    if w is None:
+                        continue
+                    idx = np.nonzero(w)[0]
+                    k = keyer(imap, w.size)
+                    yield {
+                        "modelId": str(int(eid)),
+                        "modelClass": "",
+                        "lossFunction": task.value,
+                        "means": [
+                            {"name": k(int(i))[0], "term": k(int(i))[1],
+                             "value": float(w[i])} for i in idx
+                        ],
+                        "variances": None,
+                    }
+
+            write_container(path, bayesian_linear_model_schema(), records())
+        else:
+            raise TypeError(f"unknown component model {type(comp)}")
+        written.append(path)
+    return written
