@@ -122,6 +122,33 @@ def build_colmajor(
         data parallelism — ``parallel.mesh.shard_sparse_batch``).
     """
     n, k = col_ids.shape
+    if capacity is None:
+        counts_all = np.bincount(
+            np.asarray(col_ids).reshape(-1)[
+                np.asarray(values).reshape(-1) != 0
+            ],
+            minlength=dim,
+        )
+        capacity = choose_capacity(counts_all)
+
+    # Native counting-sort build (O(nnz + dim), C++) when available;
+    # byte-identical output to the numpy path below.
+    from photon_ml_tpu.native import colmajor_build_native
+
+    native = colmajor_build_native(
+        np.asarray(col_ids), np.asarray(values), dim, capacity,
+        pad_vrows_to_multiple=pad_vrows_to_multiple,
+        pad_vrows_to=pad_vrows_to,
+    )
+    if native is not None:
+        tvals, trows, vcol = native
+        return ColMajorSlice(
+            tvals=jnp.asarray(tvals),
+            trows=jnp.asarray(trows),
+            vcol=jnp.asarray(vcol),
+            dim=dim,
+        )
+
     flat_c = np.asarray(col_ids).reshape(-1)
     flat_v = np.asarray(values).reshape(-1)
     flat_r = np.repeat(np.arange(n, dtype=np.int64), k)
@@ -135,7 +162,7 @@ def build_colmajor(
     sr = flat_r[order]
 
     counts = np.bincount(sc, minlength=dim)
-    C = capacity or choose_capacity(counts)
+    C = capacity
 
     vrows_per_col = -(-counts // C)                     # ceil, 0 for empty
     vrow_base = np.zeros(dim + 1, np.int64)

@@ -41,6 +41,12 @@ def read_libsvm(
       labels: float32 [n].
       dim: feature-space width.
     """
+    native = _read_libsvm_native(
+        path, n_features, zero_based, binary_labels_to_01
+    )
+    if native is not None:
+        return native
+
     rows: list[tuple[np.ndarray, np.ndarray]] = []
     labels: list[float] = []
     max_idx = -1
@@ -75,6 +81,53 @@ def read_libsvm(
             order = np.argsort(c)
             rows.append((c[order], v[order]))
 
+    dim = n_features if n_features is not None else max_idx + 1
+    y = np.asarray(labels, np.float32)
+    if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:
+        y = (y + 1.0) / 2.0
+    return rows, y, dim
+
+
+def _read_libsvm_native(
+    path: str,
+    n_features: int | None,
+    zero_based: bool,
+    binary_labels_to_01: bool,
+):
+    """C++ tokenizer path (photon_ml_tpu.native); None → Python fallback.
+
+    Post-processing (base conversion, out-of-space clipping, duplicate
+    summing, per-row sort) stays here in vectorized numpy so both paths
+    share one semantics definition."""
+    from photon_ml_tpu.native import libsvm_parse_native
+
+    with open(path, "rb") as f:
+        data = f.read()
+    parsed = libsvm_parse_native(data)
+    if parsed is None:
+        return None
+    labels, row_ptr, cols, vals, _ = parsed
+    base = 0 if zero_based else 1
+    cols = cols.astype(np.int64) - base
+    if cols.size and cols.min() < 0:
+        raise ValueError(
+            f"{path}: feature index below {base} (zero_based={zero_based})"
+        )
+    max_idx = -1
+    rows: list[tuple[np.ndarray, np.ndarray]] = []
+    for i in range(len(labels)):
+        c = cols[row_ptr[i]:row_ptr[i + 1]].astype(np.int32)
+        v = vals[row_ptr[i]:row_ptr[i + 1]]
+        if n_features is not None and len(c):
+            keep = c < n_features
+            c, v = c[keep], v[keep]
+        if len(c):
+            max_idx = max(max_idx, int(c.max()))
+            if len(np.unique(c)) != len(c):
+                c, inv = np.unique(c, return_inverse=True)
+                v = np.bincount(inv, weights=v).astype(np.float32)
+        order = np.argsort(c)
+        rows.append((c[order], v[order]))
     dim = n_features if n_features is not None else max_idx + 1
     y = np.asarray(labels, np.float32)
     if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:

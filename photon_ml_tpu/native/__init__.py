@@ -1,0 +1,175 @@
+"""Native C++ ETL bindings: compile-on-first-use, ctypes, numpy fallback.
+
+Reference counterpart: the JVM data plane (Spark executors deserializing
+Avro, shuffling, building per-partition iterables — SURVEY.md §5.8).
+The rebuild's data plane is host-side array construction; the hot parts
+(LIBSVM text parsing, the transposed-ELL counting sort) live in
+``fast_etl.cpp`` and are bound here.
+
+Build model: ``g++ -O3 -shared -fPIC`` into a per-version cached .so
+next to the source on first use (seconds, once).  Every caller treats
+``lib()`` returning None as "no native library" and falls back to the
+numpy implementation, so the framework works on machines with no
+toolchain.  ``PHOTON_ML_TPU_NATIVE=0`` forces the fallback (bench
+comparisons, debugging).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fast_etl.cpp")
+_SO = os.path.join(_HERE, f"_fast_etl_{sys.implementation.cache_tag}.so")
+
+_lock = threading.Lock()
+_lib: "ctypes.CDLL | None | bool" = False  # False = not yet attempted
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+        _SRC, "-o", _SO,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        sys.stderr.write(
+            f"photon_ml_tpu.native: build failed, using numpy fallback\n"
+            f"{proc.stderr[:2000]}\n"
+        )
+        return False
+    return True
+
+
+def lib() -> "ctypes.CDLL | None":
+    """The loaded native library, or None (fallback path)."""
+    global _lib
+    if _lib is not False:
+        return _lib  # type: ignore[return-value]
+    with _lock:
+        if _lib is not False:
+            return _lib  # type: ignore[return-value]
+        if os.environ.get("PHOTON_ML_TPU_NATIVE") == "0":
+            _lib = None
+            return None
+        if not os.path.exists(_SO) or (
+            os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        ):
+            if not _build():
+                _lib = None
+                return None
+        try:
+            dll = ctypes.CDLL(_SO)
+        except OSError:
+            _lib = None
+            return None
+        dll.pml_libsvm_parse.restype = ctypes.c_void_p
+        dll.pml_libsvm_parse.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        dll.pml_libsvm_sizes.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        dll.pml_libsvm_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        dll.pml_libsvm_free.argtypes = [ctypes.c_void_p]
+        dll.pml_colmajor_vrows.restype = ctypes.c_int64
+        dll.pml_colmajor_vrows.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        dll.pml_colmajor_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        _lib = dll
+        return dll
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def libsvm_parse_native(data: bytes):
+    """Parse LIBSVM text → (labels, row_ptr, cols, vals, max_col), or
+    None if the native library is unavailable.  Raises ValueError on
+    malformed input (same contract as the Python parser)."""
+    dll = lib()
+    if dll is None:
+        return None
+    handle = dll.pml_libsvm_parse(data, len(data))
+    if not handle:
+        raise ValueError("malformed LIBSVM input (native parser)")
+    try:
+        n = ctypes.c_int64()
+        nnz = ctypes.c_int64()
+        max_col = ctypes.c_int32()
+        dll.pml_libsvm_sizes(handle, ctypes.byref(n), ctypes.byref(nnz),
+                             ctypes.byref(max_col))
+        labels = np.empty(n.value, np.float32)
+        row_ptr = np.empty(n.value + 1, np.int64)
+        cols = np.empty(nnz.value, np.int32)
+        vals = np.empty(nnz.value, np.float32)
+        dll.pml_libsvm_fill(handle, _ptr(labels), _ptr(row_ptr),
+                            _ptr(cols), _ptr(vals))
+        return labels, row_ptr, cols, vals, int(max_col.value)
+    finally:
+        dll.pml_libsvm_free(handle)
+
+
+def colmajor_build_native(
+    cols: np.ndarray,
+    vals: np.ndarray,
+    dim: int,
+    capacity: int,
+    pad_vrows_to_multiple: int = 8,
+    pad_vrows_to: int | None = None,
+):
+    """Transposed-ELL build → (tvals, trows, vcol) or None (no native).
+
+    Same semantics as the numpy path in ``data.colmajor.build_colmajor``
+    except entry order within a column follows row-scan order (both are
+    valid orderings of the same multiset; sums agree).
+    """
+    dll = lib()
+    if dll is None:
+        return None
+    n, k = cols.shape
+    cols = np.ascontiguousarray(cols, np.int32)
+    vals = np.ascontiguousarray(vals, np.float32)
+    counts = np.zeros(dim, np.int64)
+    v = dll.pml_colmajor_vrows(_ptr(cols), _ptr(vals), n, k, dim,
+                               capacity, _ptr(counts))
+    if v < 0:
+        raise ValueError("column id out of range in colmajor build")
+    v_pad = max(
+        -(-max(int(v), 1) // pad_vrows_to_multiple) * pad_vrows_to_multiple,
+        8,
+    )
+    if pad_vrows_to is not None:
+        if pad_vrows_to < v:
+            raise ValueError(f"pad_vrows_to={pad_vrows_to} < V={v}")
+        v_pad = pad_vrows_to
+    tvals = np.zeros((v_pad, capacity), np.float32)
+    trows = np.zeros((v_pad, capacity), np.int32)
+    vcol = np.zeros(v_pad, np.int32)
+    dll.pml_colmajor_fill(_ptr(cols), _ptr(vals), n, k, dim, capacity,
+                          _ptr(counts), v_pad, _ptr(tvals), _ptr(trows),
+                          _ptr(vcol))
+    return tvals, trows, vcol

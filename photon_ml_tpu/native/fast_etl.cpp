@@ -1,0 +1,203 @@
+// Native host-side ETL: the rebuild's C++ runtime for data preparation.
+//
+// Reference counterpart: the JVM executors' deserialization + shuffle
+// machinery (Spark's netty/torrent substrate, SURVEY.md §5.8) and the
+// Avro decode path of AvroDataReader [expected reference structure;
+// mount unavailable].  The reference leans on the JVM for its data
+// plane; the TPU rebuild's data plane is this library + numpy, feeding
+// statically-shaped HBM arrays.
+//
+// Everything here is single-pass, cache-friendly C++ with no
+// dependencies beyond the C++17 standard library.  The Python side
+// (photon_ml_tpu.native) binds via ctypes and falls back to numpy
+// implementations when the shared object is unavailable, so the
+// framework never hard-depends on a compiler at runtime.
+//
+// Exposed surface (extern "C", handle-based two-phase protocol so the
+// caller allocates numpy arrays of exactly the right size):
+//
+//   LIBSVM text  -> CSR-ish (row_ptr, cols, vals, labels)
+//   row-ELL      -> transposed-ELL (the colmajor build: counting sort
+//                   by column + virtual-row splitting; O(nnz + dim))
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+#include <vector>
+
+namespace {
+
+struct LibsvmResult {
+  std::vector<float> labels;
+  std::vector<int64_t> row_ptr;  // [n+1]
+  std::vector<int32_t> cols;
+  std::vector<float> vals;
+  int32_t max_col = -1;
+  // Parse diagnostics
+  int64_t bad_line = -1;
+};
+
+// Minimal fast float parse: LIBSVM files carry plain decimal floats.
+// strtof handles all forms; the win over Python is avoiding per-token
+// object allocation, not exotic float parsing.
+inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// LIBSVM parsing
+// ---------------------------------------------------------------------------
+
+void* pml_libsvm_parse(const char* buf, int64_t len) {
+  auto* r = new (std::nothrow) LibsvmResult();
+  if (!r) return nullptr;
+  r->row_ptr.push_back(0);
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t line_no = 0;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (!line_end) line_end = end;
+    p = skip_ws(p, line_end);
+    if (p < line_end && *p != '#') {
+      char* q = nullptr;
+      float label = strtof(p, &q);
+      if (q == p) {
+        r->bad_line = line_no;
+        delete r;
+        return nullptr;
+      }
+      p = q;
+      while (p < line_end) {
+        p = skip_ws(p, line_end);
+        if (p >= line_end || *p == '#') break;
+        long idx = strtol(p, &q, 10);
+        if (q == p || q >= line_end || *q != ':') {
+          r->bad_line = line_no;
+          delete r;
+          return nullptr;
+        }
+        p = q + 1;
+        float v = strtof(p, &q);
+        if (q == p) {
+          r->bad_line = line_no;
+          delete r;
+          return nullptr;
+        }
+        p = q;
+        // Raw file index; 0/1-based conversion happens in Python
+        // (vectorized), which also validates the resulting range.
+        int32_t c = static_cast<int32_t>(idx);
+        if (c < 0) {
+          r->bad_line = line_no;
+          delete r;
+          return nullptr;
+        }
+        r->cols.push_back(c);
+        r->vals.push_back(v);
+        if (c > r->max_col) r->max_col = c;
+      }
+      r->labels.push_back(label);
+      r->row_ptr.push_back(static_cast<int64_t>(r->cols.size()));
+    }
+    p = line_end + 1;
+    ++line_no;
+  }
+  return r;
+}
+
+void pml_libsvm_sizes(void* handle, int64_t* n_rows, int64_t* nnz,
+                      int32_t* max_col) {
+  auto* r = static_cast<LibsvmResult*>(handle);
+  *n_rows = static_cast<int64_t>(r->labels.size());
+  *nnz = static_cast<int64_t>(r->cols.size());
+  *max_col = r->max_col;
+}
+
+void pml_libsvm_fill(void* handle, float* labels, int64_t* row_ptr,
+                     int32_t* cols, float* vals) {
+  auto* r = static_cast<LibsvmResult*>(handle);
+  memcpy(labels, r->labels.data(), r->labels.size() * sizeof(float));
+  memcpy(row_ptr, r->row_ptr.data(), r->row_ptr.size() * sizeof(int64_t));
+  memcpy(cols, r->cols.data(), r->cols.size() * sizeof(int32_t));
+  memcpy(vals, r->vals.data(), r->vals.size() * sizeof(float));
+}
+
+void pml_libsvm_free(void* handle) {
+  delete static_cast<LibsvmResult*>(handle);
+}
+
+// ---------------------------------------------------------------------------
+// Transposed-ELL (colmajor) build — see data/colmajor.py for the design.
+// Counting sort by column: O(nnz + dim), one read pass + one write pass.
+// ---------------------------------------------------------------------------
+
+// Phase 1: count virtual rows for (cols, vals, capacity).  Returns V, or
+// -1 on invalid input.  col_counts must be a caller-zeroed [dim] int64
+// scratch; it is left holding the per-column nonzero counts for phase 2.
+int64_t pml_colmajor_vrows(const int32_t* cols, const float* vals,
+                           int64_t n, int64_t k, int64_t dim,
+                           int64_t capacity, int64_t* col_counts) {
+  const int64_t total = n * k;
+  for (int64_t e = 0; e < total; ++e) {
+    if (vals[e] != 0.0f) {
+      const int32_t c = cols[e];
+      if (c < 0 || c >= dim) return -1;
+      ++col_counts[c];
+    }
+  }
+  int64_t v = 0;
+  for (int64_t j = 0; j < dim; ++j) {
+    v += (col_counts[j] + capacity - 1) / capacity;
+  }
+  return v;
+}
+
+// Phase 2: fill caller-allocated tvals [v_pad*capacity] (zeroed),
+// trows [v_pad*capacity] (zeroed), vcol [v_pad] (zeroed).  col_counts is
+// the phase-1 output.  Entries keep row order within each column
+// (counting sort is stable in row-scan order).
+void pml_colmajor_fill(const int32_t* cols, const float* vals,
+                       int64_t n, int64_t k, int64_t dim,
+                       int64_t capacity, const int64_t* col_counts,
+                       int64_t v_pad, float* tvals, int32_t* trows,
+                       int32_t* vcol) {
+  // Per-column virtual-row base and running cursor.
+  std::vector<int64_t> vrow_base(static_cast<size_t>(dim) + 1, 0);
+  for (int64_t j = 0; j < dim; ++j) {
+    vrow_base[static_cast<size_t>(j) + 1] =
+        vrow_base[static_cast<size_t>(j)] +
+        (col_counts[j] + capacity - 1) / capacity;
+  }
+  for (int64_t j = 0; j < dim; ++j) {
+    const int64_t first = vrow_base[static_cast<size_t>(j)];
+    const int64_t nv = vrow_base[static_cast<size_t>(j) + 1] - first;
+    for (int64_t t = 0; t < nv; ++t) {
+      vcol[first + t] = static_cast<int32_t>(j);
+    }
+  }
+  std::vector<int64_t> cursor(static_cast<size_t>(dim), 0);
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t row_off = i * k;
+    for (int64_t s = 0; s < k; ++s) {
+      const float v = vals[row_off + s];
+      if (v == 0.0f) continue;
+      const int32_t c = cols[row_off + s];
+      const int64_t pos = cursor[c]++;
+      const int64_t vr = vrow_base[static_cast<size_t>(c)] + pos / capacity;
+      const int64_t slot = pos % capacity;
+      tvals[vr * capacity + slot] = v;
+      trows[vr * capacity + slot] = static_cast<int32_t>(i);
+    }
+  }
+  (void)v_pad;
+}
+
+}  // extern "C"
