@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import json
 import sys
-import time
 
 import numpy as np
 
@@ -40,22 +39,13 @@ def _make_ell(n: int, d: int, k: int, seed: int = 0):
 
 
 def _time_fn(fn, *args, iters: int = 20) -> float:
-    """Median wall-clock seconds per call (after warmup compile)."""
-    out = fn(*args)
-    jax_block(out)
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        out = fn(*args)
-        jax_block(out)
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+    """Seconds per call via queue-drain timing (``utils.timing.measure``):
+    ``jax.block_until_ready`` is unreliable through async dispatch tunnels
+    (returns before device execution), so fence with a host fetch after
+    dispatching ``iters`` calls back to back."""
+    from photon_ml_tpu.utils.timing import measure
 
-
-def jax_block(out):
-    import jax
-
-    jax.block_until_ready(out)
+    return measure(fn, *args, iters=iters)
 
 
 def main() -> None:

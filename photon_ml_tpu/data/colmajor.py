@@ -122,6 +122,7 @@ def build_colmajor(
         data parallelism — ``parallel.mesh.shard_sparse_batch``).
     """
     n, k = col_ids.shape
+    counts_all = None
     if capacity is None:
         counts_all = np.bincount(
             np.asarray(col_ids).reshape(-1)[
@@ -161,7 +162,11 @@ def build_colmajor(
     sv = flat_v[order]
     sr = flat_r[order]
 
-    counts = np.bincount(sc, minlength=dim)
+    counts = (
+        counts_all
+        if counts_all is not None
+        else np.bincount(sc, minlength=dim)
+    )
     C = capacity
 
     vrows_per_col = -(-counts // C)                     # ceil, 0 for empty

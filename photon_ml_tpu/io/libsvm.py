@@ -57,10 +57,16 @@ def read_libsvm(
                 continue
             parts = line.split()
             labels.append(float(parts[0]))
+            base = 0 if zero_based else 1
             idxs, vals = [], []
             for tok in parts[1:]:
                 i_str, v_str = tok.split(":")
-                i = int(i_str) - (0 if zero_based else 1)
+                i = int(i_str) - base
+                if i < 0:
+                    raise ValueError(
+                        f"{path}: feature index below {base} "
+                        f"(zero_based={zero_based})"
+                    )
                 idxs.append(i)
                 vals.append(float(v_str))
             c = np.asarray(idxs, np.int32)
@@ -99,8 +105,10 @@ def _read_libsvm_native(
     Post-processing (base conversion, out-of-space clipping, duplicate
     summing, per-row sort) stays here in vectorized numpy so both paths
     share one semantics definition."""
-    from photon_ml_tpu.native import libsvm_parse_native
+    from photon_ml_tpu.native import libsvm_parse_native, native_available
 
+    if not native_available():
+        return None
     with open(path, "rb") as f:
         data = f.read()
     parsed = libsvm_parse_native(data)

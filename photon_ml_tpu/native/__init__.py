@@ -102,6 +102,11 @@ def lib() -> "ctypes.CDLL | None":
         return dll
 
 
+def native_available() -> bool:
+    """True when the native library is loaded (or loadable)."""
+    return lib() is not None
+
+
 def _ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.c_void_p)
 

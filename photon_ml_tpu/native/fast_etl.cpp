@@ -34,8 +34,6 @@ struct LibsvmResult {
   std::vector<int32_t> cols;
   std::vector<float> vals;
   int32_t max_col = -1;
-  // Parse diagnostics
-  int64_t bad_line = -1;
 };
 
 // Minimal fast float parse: LIBSVM files carry plain decimal floats.
@@ -60,7 +58,6 @@ void* pml_libsvm_parse(const char* buf, int64_t len) {
   r->row_ptr.push_back(0);
   const char* p = buf;
   const char* end = buf + len;
-  int64_t line_no = 0;
   while (p < end) {
     const char* line_end = static_cast<const char*>(
         memchr(p, '\n', static_cast<size_t>(end - p)));
@@ -69,8 +66,7 @@ void* pml_libsvm_parse(const char* buf, int64_t len) {
     if (p < line_end && *p != '#') {
       char* q = nullptr;
       float label = strtof(p, &q);
-      if (q == p) {
-        r->bad_line = line_no;
+      if (q == p || q > line_end) {
         delete r;
         return nullptr;
       }
@@ -80,26 +76,26 @@ void* pml_libsvm_parse(const char* buf, int64_t len) {
         if (p >= line_end || *p == '#') break;
         long idx = strtol(p, &q, 10);
         if (q == p || q >= line_end || *q != ':') {
-          r->bad_line = line_no;
           delete r;
           return nullptr;
         }
         p = q + 1;
         float v = strtof(p, &q);
-        if (q == p) {
-          r->bad_line = line_no;
+        // strtof may legally run past line_end (the buffer is contiguous
+        // across lines), which would silently consume the next line's
+        // tokens; a value must both exist and end within its own line.
+        if (q == p || q > line_end) {
           delete r;
           return nullptr;
         }
         p = q;
         // Raw file index; 0/1-based conversion happens in Python
         // (vectorized), which also validates the resulting range.
-        int32_t c = static_cast<int32_t>(idx);
-        if (c < 0) {
-          r->bad_line = line_no;
+        if (idx < 0 || idx > INT32_MAX) {
           delete r;
           return nullptr;
         }
+        int32_t c = static_cast<int32_t>(idx);
         r->cols.push_back(c);
         r->vals.push_back(v);
         if (c > r->max_col) r->max_col = c;
@@ -108,7 +104,6 @@ void* pml_libsvm_parse(const char* buf, int64_t len) {
       r->row_ptr.push_back(static_cast<int64_t>(r->cols.size()));
     }
     p = line_end + 1;
-    ++line_no;
   }
   return r;
 }
