@@ -98,6 +98,11 @@ def lib() -> "ctypes.CDLL | None":
             ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p,
         ]
+        dll.pml_edge_color.restype = ctypes.c_int32
+        dll.pml_edge_color.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
+        ]
         _lib = dll
         return dll
 
@@ -136,6 +141,29 @@ def libsvm_parse_native(data: bytes):
         return labels, row_ptr, cols, vals, int(max_col.value)
     finally:
         dll.pml_libsvm_free(handle)
+
+
+def edge_color_native(
+    src: np.ndarray, dst: np.ndarray, n_left: int, n_right: int,
+    n_colors: int,
+) -> "np.ndarray | None":
+    """Proper edge coloring of a bipartite multigraph (Euler split).
+
+    Every vertex's degree must be divisible by ``n_colors`` (a power of
+    two).  Returns int32 colors per edge, or None when the native
+    library is unavailable (callers fall back to the Python colorer in
+    ``ops.crossbar``)."""
+    dll = lib()
+    if dll is None:
+        return None
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    color = np.empty(src.size, np.int32)
+    rc = dll.pml_edge_color(_ptr(src), _ptr(dst), src.size, n_left,
+                            n_right, n_colors, _ptr(color))
+    if rc != 0:
+        raise ValueError("pml_edge_color: invalid arguments")
+    return color
 
 
 def colmajor_build_native(

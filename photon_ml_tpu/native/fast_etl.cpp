@@ -196,3 +196,129 @@ void pml_colmajor_fill(const int32_t* cols, const float* vals,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Bipartite Euler-split edge coloring — the router for in-tile crossbars.
+//
+// A static permutation of a [R,128] VMEM tile is executed on TPU as
+// lane-perm ∘ transpose ∘ lane-perm ∘ transpose ∘ lane-perm (see
+// ops/crossbar.py).  The middle lane-perm is legal iff the edges
+// (src_row → dst_row) are properly colored with 128 colors such that no
+// two edges at the same vertex share a color.  With every vertex of
+// degree exactly n_colors (a power of two; padding slots make this true
+// by construction), repeated Euler splitting yields an exact coloring in
+// O(m log n_colors): each split walks Euler circuits and alternates
+// edges between halves, preserving even degrees.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// One Euler-split level: partition edges[lo:hi) (indices into src/dst)
+// into first half = color bit 0, second half = bit 1, by walking Euler
+// circuits and alternating.  src[e] in [0,L), dst[e] in [0,R_n).
+// Every vertex degree within the subset must be even.
+void euler_split_level(const int32_t* src, const int32_t* dst,
+                       int64_t* edge_ids, int64_t lo, int64_t hi,
+                       int32_t n_left, int32_t n_right,
+                       std::vector<int64_t>& head,
+                       std::vector<int64_t>& nxt,
+                       std::vector<int64_t>& prv,
+                       std::vector<uint8_t>& used,
+                       std::vector<uint8_t>& side_out) {
+  // Build doubly-linked adjacency over vertices 0..n_left-1 (left) and
+  // n_left..n_left+n_right-1 (right); each edge appears once per side
+  // via two arc slots (2e, 2e+1).
+  const int32_t nv = n_left + n_right;
+  for (int32_t v = 0; v < nv; ++v) head[v] = -1;
+  for (int64_t i = lo; i < hi; ++i) {
+    const int64_t e = edge_ids[i];
+    used[e] = 0;
+    const int64_t a0 = 2 * e, a1 = 2 * e + 1;
+    const int32_t u = src[e], w = n_left + dst[e];
+    nxt[a0] = head[u]; prv[a0] = -1;
+    if (head[u] >= 0) prv[head[u]] = a0;
+    head[u] = a0;
+    nxt[a1] = head[w]; prv[a1] = -1;
+    if (head[w] >= 0) prv[head[w]] = a1;
+    head[w] = a1;
+  }
+  auto detach = [&](int64_t arc, int32_t v) {
+    if (prv[arc] >= 0) nxt[prv[arc]] = nxt[arc];
+    else head[v] = nxt[arc];
+    if (nxt[arc] >= 0) prv[nxt[arc]] = prv[arc];
+  };
+  // Walk circuits: from any vertex with remaining edges, follow unused
+  // edges until returning; alternate sides along the walk.  On a graph
+  // with all even degrees the walk can only get stuck at its start
+  // vertex, at which point we continue from any still-incident vertex.
+  for (int64_t i = lo; i < hi; ++i) {
+    const int64_t e0 = edge_ids[i];
+    if (used[e0]) continue;
+    int32_t v = src[e0];
+    uint8_t side = 0;
+    while (head[v] >= 0) {
+      const int64_t arc = head[v];
+      const int64_t e = arc >> 1;
+      const int32_t u = src[e], w = n_left + dst[e];
+      detach(2 * e, u);
+      detach(2 * e + 1, w);
+      used[e] = 1;
+      side_out[e] = side;
+      side ^= 1;
+      v = (v == u) ? w : u;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Color m edges (src[e] in [0,n_left), dst[e] in [0,n_right)) with
+// n_colors colors (power of two).  Every left/right vertex must have
+// degree divisible by n_colors... in the crossbar use-case degree ==
+// n_colors exactly.  Writes color[e] in [0, n_colors).  Returns 0, or
+// -1 on invalid arguments.
+int32_t pml_edge_color(const int32_t* src, const int32_t* dst, int64_t m,
+                       int32_t n_left, int32_t n_right, int32_t n_colors,
+                       int32_t* color) {
+  if (n_colors <= 0 || (n_colors & (n_colors - 1)) != 0) return -1;
+  std::vector<int64_t> edge_ids(static_cast<size_t>(m));
+  for (int64_t e = 0; e < m; ++e) { edge_ids[e] = e; color[e] = 0; }
+  std::vector<int64_t> head(static_cast<size_t>(n_left + n_right));
+  std::vector<int64_t> nxt(static_cast<size_t>(2 * m));
+  std::vector<int64_t> prv(static_cast<size_t>(2 * m));
+  std::vector<uint8_t> used(static_cast<size_t>(m));
+  std::vector<uint8_t> side(static_cast<size_t>(m));
+  std::vector<int64_t> scratch(static_cast<size_t>(m));
+
+  // Iterative halving: ranges of edge_ids sharing a color prefix are
+  // split; bit b of the color is assigned at level b (MSB first).
+  int32_t levels = 0;
+  for (int32_t c = n_colors; c > 1; c >>= 1) ++levels;
+  std::vector<std::pair<int64_t, int64_t>> ranges{{0, m}};
+  for (int32_t level = 0; level < levels; ++level) {
+    std::vector<std::pair<int64_t, int64_t>> next_ranges;
+    for (auto [lo, hi] : ranges) {
+      if (hi - lo == 0) continue;
+      euler_split_level(src, dst, edge_ids.data(), lo, hi, n_left,
+                        n_right, head, nxt, prv, used, side);
+      // Stable partition: side 0 first.
+      int64_t w0 = lo;
+      for (int64_t i = lo; i < hi; ++i)
+        if (!side[edge_ids[i]]) scratch[w0++] = edge_ids[i];
+      int64_t mid = w0;
+      for (int64_t i = lo; i < hi; ++i)
+        if (side[edge_ids[i]]) scratch[w0++] = edge_ids[i];
+      for (int64_t i = lo; i < hi; ++i) edge_ids[i] = scratch[i];
+      const int32_t bit = 1 << (levels - 1 - level);
+      for (int64_t i = mid; i < hi; ++i) color[edge_ids[i]] |= bit;
+      next_ranges.emplace_back(lo, mid);
+      next_ranges.emplace_back(mid, hi);
+    }
+    ranges = std::move(next_ranges);
+  }
+  return 0;
+}
+
+}  // extern "C"
