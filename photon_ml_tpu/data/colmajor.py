@@ -72,9 +72,10 @@ class ColMajorSlice:
     def xt_dot(self, r: Array) -> Array:
         """Xᵀ r without a large scatter: gather r, row-sum, tiny fold.
 
-        The gather+rowsum runs through the Pallas kernel on TPU (see
-        ``ops.kernels.gather_rowsum``); the final ``segment_sum`` is over
-        V virtual rows with sorted ids — cheap in XLA.
+        Note this XLA formulation still pays XLA's scalar gather; it
+        exists as the mesh-shardable fallback.  The fast TPU path is the
+        GRR layout (``data.grr_batch``), which replaces both this and
+        the row-major gather with Mosaic lane-gather kernels.
         """
         from photon_ml_tpu.ops.kernels import gather_rowsum
 
@@ -105,7 +106,7 @@ def build_colmajor(
     values: np.ndarray,
     dim: int,
     capacity: int | None = None,
-    pad_vrows_to_multiple: int = 8,
+    pad_vrows_to_multiple: int | None = None,
     pad_vrows_to: int | None = None,
 ) -> ColMajorSlice:
     """Build the transposed-ELL arrays from host-side row-ELL arrays.
@@ -117,7 +118,9 @@ def build_colmajor(
         dropped (they contribute nothing to any contraction).
       dim: feature-space width.
       capacity: virtual-row capacity C (default: ``choose_capacity``).
-      pad_vrows_to_multiple: pad V up so row tiles stay aligned.
+      pad_vrows_to_multiple: pad V up so row tiles stay aligned
+        (default: ``ops.kernels.round_up_rows`` — kernel-friendly, so
+        the Pallas gather always has a whole-block grid over V).
       pad_vrows_to: pad V to exactly this (for equal-shape shards under
         data parallelism — ``parallel.mesh.shard_sparse_batch``).
     """
@@ -173,9 +176,9 @@ def build_colmajor(
     vrow_base = np.zeros(dim + 1, np.int64)
     np.cumsum(vrows_per_col, out=vrow_base[1:])
     V = int(vrow_base[-1])
-    V_pad = max(
-        -(-max(V, 1) // pad_vrows_to_multiple) * pad_vrows_to_multiple, 8
-    )
+    from photon_ml_tpu.ops.kernels import vrow_pad
+
+    V_pad = vrow_pad(V, pad_vrows_to_multiple)
     if pad_vrows_to is not None:
         if pad_vrows_to < V:
             raise ValueError(f"pad_vrows_to={pad_vrows_to} < V={V}")

@@ -171,7 +171,7 @@ def colmajor_build_native(
     vals: np.ndarray,
     dim: int,
     capacity: int,
-    pad_vrows_to_multiple: int = 8,
+    pad_vrows_to_multiple: int | None = None,
     pad_vrows_to: int | None = None,
 ):
     """Transposed-ELL build → (tvals, trows, vcol) or None (no native).
@@ -191,10 +191,9 @@ def colmajor_build_native(
                                capacity, _ptr(counts))
     if v < 0:
         raise ValueError("column id out of range in colmajor build")
-    v_pad = max(
-        -(-max(int(v), 1) // pad_vrows_to_multiple) * pad_vrows_to_multiple,
-        8,
-    )
+    from photon_ml_tpu.ops.kernels import vrow_pad
+
+    v_pad = vrow_pad(int(v), pad_vrows_to_multiple)
     if pad_vrows_to is not None:
         if pad_vrows_to < v:
             raise ValueError(f"pad_vrows_to={pad_vrows_to} < V={v}")

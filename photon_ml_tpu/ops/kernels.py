@@ -1,4 +1,4 @@
-"""The sparse hot-op: ``rowsum(vals · table[ids])`` — XLA and Pallas paths.
+"""The sparse hot-op: ``rowsum(vals · table[ids])`` — the XLA formulation.
 
 Both directions of the sparse GLM hot loop are instances of one
 gather-contract primitive over a padded-ELL tile:
@@ -10,69 +10,78 @@ gather-contract primitive over a padded-ELL tile:
 Reference counterpart: the per-example fold inside
 ``ValueAndGradientAggregator`` (photon-lib
 ``com.linkedin.photon.ml.function.glm`` [expected path, mount unavailable
-— SURVEY.md §2.2]).  The reference's hot loop is scalar JVM code over
-Breeze sparse vectors; here it is one vectorized gather+multiply+reduce,
-and on TPU a Pallas kernel that keeps the gather table resident in VMEM
-and streams ELL tiles HBM→VMEM, so each nonzero costs ~8 bytes of HBM
-traffic and no scatter ever happens (design rationale in
-``data/colmajor.py``).
+— SURVEY.md §2.2]).
 
-Dispatch:
-- TPU backend + aligned shapes + table fits VMEM → Pallas kernel.
-- anything else (CPU tests, virtual meshes, odd shapes) → pure-XLA
-  ``jnp.sum(vals * table[ids], -1)``, which XLA fuses well everywhere
-  except the TPU gather (the thing the kernel exists to fix).
-- ``PHOTON_ML_TPU_PALLAS=0|1`` forces the choice (0 is the escape hatch
-  if a jax/libtpu regression breaks the kernel; 1 + interpret mode is
-  how CPU tests exercise the kernel body).
+``gather_rowsum`` is the pure-XLA formulation.  XLA lowers the gather to
+a *scalar* loop on TPU (measured ~1 GB/s effective bandwidth on v5e —
+~800× off the HBM roofline), so this path is only acceptable for small
+batches, CPU tests, and fallbacks.  The production TPU path is the GRR
+(gather-route-reduce) blocked layout in ``ops.grr`` + ``ops.grr_kernel``,
+which ``SparseBatch`` dispatches to when the batch was built with it;
+there the same contraction runs as Mosaic lane-gathers and crossbar
+routes at near memory bandwidth.
+
+``_pallas_gather_rowsum`` below is a naive whole-table-in-VMEM kernel
+kept ONLY for interpret-mode tests of the gather-contract semantics: its
+``table_ref[ids]`` body cannot be lowered by Mosaic on real TPUs
+(verified on v5e: "Cannot do int indexing on TPU").  Nothing dispatches
+to it.
 """
 
 from __future__ import annotations
-
-import functools
-import os
 
 import jax
 import jax.numpy as jnp
 
 Array = jax.Array
 
-# Tables larger than this stay on the XLA path: the kernel holds the full
-# gather table in VMEM (~16 MB/core on v5e) alongside double-buffered ELL
-# tiles.  8 MB ≈ a 2M-row f32 table — covers w up to d=2M and residuals
-# up to n=2M per device shard; beyond that, shard the batch.
-_MAX_TABLE_BYTES = 8 * 1024 * 1024
-
-
-def _want_pallas() -> bool:
-    env = os.environ.get("PHOTON_ML_TPU_PALLAS")
-    if env == "0":
-        return False
-    if env == "1":
-        return True
-    return jax.default_backend() == "tpu"
-
 
 def _xla_gather_rowsum(table: Array, vals: Array, ids: Array) -> Array:
     return jnp.sum(vals * table[ids], axis=-1)
 
 
-def _row_tile(capacity: int, n_rows: int) -> int:
-    """Rows per grid step: target ~64k elements per (vals, ids) tile so
-    two tiles double-buffer comfortably under the VMEM budget, clamped
-    to the row count (tiny batches = one grid step)."""
-    t = max(8, (65536 // max(capacity, 1)) // 8 * 8)
-    return min(t, max(8, n_rows // 8 * 8))
+def gather_rowsum(table: Array, vals: Array, ids: Array) -> Array:
+    """``out[i] = Σ_k vals[i,k] · table[ids[i,k]]``.
+
+    Args:
+      table: [L] float — the gather table (w for margins, r for Xᵀr).
+      vals:  [n, k] float — ELL values (padding slots are 0).
+      ids:   [n, k] int32 — ELL indices into ``table`` (padding → 0).
+    """
+    return _xla_gather_rowsum(table, vals, ids)
+
+
+def round_up_rows(n_rows: int) -> int:
+    """Smallest tile-friendly row count ≥ ``n_rows``: a multiple of 1024
+    for large arrays, of 8 (the f32 sublane count) for small ones.
+    Callers that want whole-tile grids over row-blocked arrays pad with
+    this; padding rows are masked/zero-valued."""
+    m = 1024 if n_rows > 8192 else 8
+    return -(-n_rows // m) * m
+
+
+def vrow_pad(v: int, multiple: int | None) -> int:
+    """Padded virtual-row count for the transposed-ELL build: explicit
+    ``multiple`` when given, else ``round_up_rows``.  The single source
+    of truth shared by the numpy and native colmajor builders (their
+    outputs must stay byte-identical)."""
+    v = max(int(v), 1)
+    if multiple is None:
+        return round_up_rows(v)
+    return max(-(-v // multiple) * multiple, 8)
 
 
 def _pallas_gather_rowsum(table: Array, vals: Array, ids: Array,
                           interpret: bool = False) -> Array:
+    """Interpret-mode-only reference kernel (see module docstring)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n, k = vals.shape
-    tile = _row_tile(k, n)
-    grid = n // tile
+    tile = max(8, min(n, 512) // 8 * 8)
+    if n % tile != 0:
+        tile = 8
+    assert n % tile == 0, (n, tile)
 
     def kernel(table_ref, vals_ref, ids_ref, out_ref):
         gathered = table_ref[ids_ref[:]]          # [tile, k] VMEM gather
@@ -80,7 +89,7 @@ def _pallas_gather_rowsum(table: Array, vals: Array, ids: Array,
 
     return pl.pallas_call(
         kernel,
-        grid=(grid,),
+        grid=(n // tile,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),            # full table
             pl.BlockSpec((tile, k), lambda i: (i, 0),
@@ -93,22 +102,3 @@ def _pallas_gather_rowsum(table: Array, vals: Array, ids: Array,
         out_shape=jax.ShapeDtypeStruct((n,), vals.dtype),
         interpret=interpret,
     )(table, vals, ids)
-
-
-def gather_rowsum(table: Array, vals: Array, ids: Array) -> Array:
-    """``out[i] = Σ_k vals[i,k] · table[ids[i,k]]`` with TPU dispatch.
-
-    Args:
-      table: [L] float — the gather table (w for margins, r for Xᵀr).
-      vals:  [n, k] float — ELL values (padding slots are 0).
-      ids:   [n, k] int32 — ELL indices into ``table`` (padding → 0).
-    """
-    n, k = vals.shape
-    if (
-        _want_pallas()
-        and table.ndim == 1
-        and table.size * table.dtype.itemsize <= _MAX_TABLE_BYTES
-        and n % _row_tile(k, n) == 0
-    ):
-        return _pallas_gather_rowsum(table, vals, ids)
-    return _xla_gather_rowsum(table, vals, ids)

@@ -150,10 +150,12 @@ def shard_sparse_batch(
             )
             for b in shards
         ]
+        from photon_ml_tpu.ops.kernels import vrow_pad
+
         v_max = max(
             int((-(-c // col_capacity)).sum()) for c in shard_counts
         )
-        v_max = max(-(-max(v_max, 1) // 8) * 8, 8)
+        v_max = vrow_pad(v_max, None)
         shards = [
             b.replace(colmajor=build_colmajor(
                 np.asarray(b.col_ids), np.asarray(b.values), dim,
