@@ -1,0 +1,20 @@
+import logging, time, sys
+logging.basicConfig(level=logging.DEBUG, stream=sys.stderr,
+                    format="%(message)s")
+logging.getLogger("jax").setLevel(logging.WARNING)
+import numpy as np
+n, d, k = 1_000_000, 100_000, 30
+rng = np.random.default_rng(0)
+block = d // k
+cols = ((np.arange(k, dtype=np.int64) * block)[None, :] + rng.integers(0, block, (n, k))).astype(np.int32)
+vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+from photon_ml_tpu.data.grr import build_grr_direction
+r_idx = np.repeat(np.arange(n, dtype=np.int64), k)
+c = cols.reshape(-1).astype(np.int64)
+v = vals.reshape(-1)
+t0 = time.time()
+d_row = build_grr_direction(idx=c, seg=r_idx, val=v, table_len=d, n_segments=n)
+print(f"row dir total {time.time()-t0:.1f}s", file=sys.stderr)
+t0 = time.time()
+d_col = build_grr_direction(idx=r_idx, seg=c, val=v, table_len=n, n_segments=d)
+print(f"col dir total {time.time()-t0:.1f}s", file=sys.stderr)

@@ -1,0 +1,78 @@
+"""Time the GRR fused step only (ETL cached to disk across runs)."""
+import sys, time, os, pickle
+import numpy as np
+import jax, jax.numpy as jnp
+def log(m): print(m, file=sys.stderr, flush=True)
+
+from photon_ml_tpu.data.batch import SparseBatch
+from photon_ml_tpu.data.grr import build_grr_pair
+from photon_ml_tpu.data.normalization import NormalizationContext
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.utils.timing import measure
+
+n, d, k = 1_000_000, 100_000, 30
+rng = np.random.default_rng(0)
+block = d // k
+cols = ((np.arange(k, dtype=np.int64) * block)[None, :]
+        + rng.integers(0, block, (n, k))).astype(np.int32)
+vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+labels = (rng.uniform(size=n) < 0.5).astype(np.float32)
+
+cachef = "/tmp/grr_pair_cache.pkl"
+if os.path.exists(cachef):
+    with open(cachef, "rb") as f:
+        host = pickle.load(f)
+    pair = jax.tree.map(jnp.asarray, host[0], is_leaf=lambda x: isinstance(x, np.ndarray))
+    log("pair loaded from cache")
+else:
+    t0 = time.time()
+    pair = build_grr_pair(cols, vals, d)
+    log(f"ETL {time.time()-t0:.0f}s")
+    host = (jax.tree.map(np.asarray, pair),)
+    with open(cachef, "wb") as f:
+        pickle.dump(host, f)
+
+batch = SparseBatch(
+    values=jnp.asarray(vals), col_ids=jnp.asarray(cols),
+    labels=jnp.asarray(labels), weights=jnp.ones((n,), jnp.float32),
+    offsets=jnp.zeros((n,), jnp.float32), mask=jnp.ones((n,), jnp.float32),
+    dim=d, grr=pair)
+obj = GLMObjective(loss=losses.LOGISTIC, reg=RegularizationContext.l2(1.0),
+                   norm=NormalizationContext.identity())
+w = jnp.asarray(rng.normal(0, 0.1, d), jnp.float32)
+
+def chain(w, batch, length=20):
+    def body(c, _):
+        v, g = obj.value_and_gradient(c, batch)
+        return c - 1e-6 * g, None
+    out, _ = jax.lax.scan(body, w, None, length=length)
+    return out
+
+f = jax.jit(chain)
+t0 = time.time(); jax.block_until_ready(f(w, batch)); log(f"compile {time.time()-t0:.1f}s")
+s = measure(f, w, batch, iters=3) / 20
+log(f"GRR fused value+grad: {s*1e3:.2f} ms/step  {n/s:.3e} ex/s")
+
+# margins-only and grad-only pieces
+def chain_m(w, batch, length=20):
+    def body(c, _):
+        m = batch.margins(c[:d])
+        return c.at[0].add(m[0] * 1e-20), None
+    out, _ = jax.lax.scan(body, w, None, length=length)
+    return out
+fm = jax.jit(chain_m)
+jax.block_until_ready(fm(w, batch))
+log(f"margins only: {measure(fm, w, batch, iters=3)/20*1e3:.2f} ms")
+
+r = jnp.asarray(rng.normal(0, 1, n), jnp.float32)
+def chain_g(r, batch, length=20):
+    def body(c, _):
+        g = batch.xt_dot(c)
+        return c.at[0].add(g[0] * 1e-20), None
+    out, _ = jax.lax.scan(body, r, None, length=length)
+    return out
+fg = jax.jit(chain_g)
+jax.block_until_ready(fg(r, batch))
+log(f"xt_dot only: {measure(fg, r, batch, iters=3)/20*1e3:.2f} ms")

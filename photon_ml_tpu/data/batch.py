@@ -36,6 +36,7 @@ import numpy as np
 from flax import struct
 
 from photon_ml_tpu.data.colmajor import ColMajorSlice, build_colmajor
+from photon_ml_tpu.data.grr import GrrPair, build_grr_pair
 
 Array = jax.Array
 
@@ -79,12 +80,19 @@ class SparseBatch:
     gathers stay in-bounds and scatters add zero; correctness never depends
     on the padding target.
 
-    ``colmajor`` optionally carries the transposed-ELL copy of the same
-    nonzeros (``data.colmajor``); when present, ``xt_dot`` — the gradient
-    contraction Xᵀr — runs scatter-free (gather + row-sum + tiny fold)
-    instead of a full-size ``segment_sum``, which on TPU is the
-    difference between ~1 GB/s and near-roofline HBM bandwidth.  Build it
-    with ``make_sparse_batch(..., col_major=True)``.
+    Layout variants for the two contractions (margins X·w, gradient Xᵀr):
+
+    - ``grr`` (``data.grr.GrrPair``, build with ``make_sparse_batch(...,
+      grr=True)``): the production TPU path — both directions compiled
+      into the gather-route-reduce plan executed by a Mosaic kernel at
+      vector speed, with hot columns on the MXU.  ~100× faster than the
+      XLA formulations on v5e.
+    - ``colmajor`` (``data.colmajor``): transposed-ELL copy making Xᵀr
+      a gather+segment-fold instead of a full scatter.  Still pays
+      XLA's scalar gather on TPU; useful as the mesh-shardable layout
+      and on CPU.
+    - neither: plain ELL — margins via XLA gather, Xᵀr via
+      ``segment_sum`` scatter.  Fine for small batches and tests.
     """
 
     values: Array     # [n, k] float
@@ -95,20 +103,25 @@ class SparseBatch:
     mask: Array       # [n] float
     dim: int = struct.field(pytree_node=False)
     colmajor: "ColMajorSlice | None" = None
+    grr: "GrrPair | None" = None
 
     @property
     def n_padded(self) -> int:
         return self.values.shape[-2]
 
     def margins(self, w: Array) -> Array:
-        """Σ_k values[i,k]·w[col_ids[i,k]] + offset — gather + row reduce."""
+        """Σ_k values[i,k]·w[col_ids[i,k]] + offset."""
+        if self.grr is not None:
+            return self.grr.dot(w) + self.offsets
         from photon_ml_tpu.ops.kernels import gather_rowsum
 
         return gather_rowsum(w, self.values, self.col_ids) + self.offsets
 
     def xt_dot(self, r: Array) -> Array:
-        """X^T r: transposed gather+rowsum when ``colmajor`` is present,
-        else a segment-sum scatter-add into the [dim] gradient."""
+        """X^T r — GRR kernel, else transposed-ELL gather, else a
+        segment-sum scatter-add into the [dim] gradient."""
+        if self.grr is not None:
+            return self.grr.t_dot(r)
         if self.colmajor is not None:
             return self.colmajor.xt_dot(r)
         contrib = self.values * r[:, None]            # [n, k]
@@ -119,6 +132,8 @@ class SparseBatch:
         )
 
     def x_dot(self, v: Array) -> Array:
+        if self.grr is not None:
+            return self.grr.dot(v)
         from photon_ml_tpu.ops.kernels import gather_rowsum
 
         return gather_rowsum(v, self.values, self.col_ids)
@@ -178,6 +193,7 @@ def make_sparse_batch(
     dtype=jnp.float32,
     col_major: bool = False,
     col_capacity: int | None = None,
+    grr: bool = False,
 ) -> SparseBatch:
     """Build a padded-ELL SparseBatch.
 
@@ -187,10 +203,11 @@ def make_sparse_batch(
       row_capacity: per-row nnz capacity; defaults to the max observed.
       pad_to: pad the example count to this (e.g. a multiple of shard count).
       col_major: also build the transposed-ELL copy so gradients run
-        scatter-free (see ``data.colmajor``; costs one extra copy of the
-        nonzeros in HBM — worth it whenever the batch is iterated on).
+        without the full-size scatter (see ``data.colmajor``).
       col_capacity: virtual-row capacity for the transpose (default:
         auto from the column-occupancy distribution).
+      grr: compile the GRR plan (``data.grr``) — the fast TPU path for
+        both contraction directions; supersedes ``col_major`` when set.
     """
     n = len(rows)
     k = row_capacity or max((len(c) for c, _ in rows), default=1)
@@ -223,9 +240,10 @@ def make_sparse_batch(
     mask[:n] = 1.0
     cm = (
         build_colmajor(cols, vals, dim, capacity=col_capacity)
-        if col_major
+        if col_major and not grr
         else None
     )
+    pair = build_grr_pair(cols, vals, dim) if grr else None
     return SparseBatch(
         values=jnp.asarray(vals, dtype),
         col_ids=jnp.asarray(cols),
@@ -235,4 +253,5 @@ def make_sparse_batch(
         mask=jnp.asarray(mask, dtype),
         dim=dim,
         colmajor=cm,
+        grr=pair,
     )

@@ -74,8 +74,8 @@ class ColMajorSlice:
 
         Note this XLA formulation still pays XLA's scalar gather; it
         exists as the mesh-shardable fallback.  The fast TPU path is the
-        GRR layout (``data.grr_batch``), which replaces both this and
-        the row-major gather with Mosaic lane-gather kernels.
+        GRR layout (``data.grr``), which replaces both this and the
+        row-major gather with Mosaic lane-gather kernels.
         """
         from photon_ml_tpu.ops.kernels import gather_rowsum
 
@@ -119,8 +119,7 @@ def build_colmajor(
       dim: feature-space width.
       capacity: virtual-row capacity C (default: ``choose_capacity``).
       pad_vrows_to_multiple: pad V up so row tiles stay aligned
-        (default: ``ops.kernels.round_up_rows`` — kernel-friendly, so
-        the Pallas gather always has a whole-block grid over V).
+        (default: multiple of 8, the f32 sublane count).
       pad_vrows_to: pad V to exactly this (for equal-shape shards under
         data parallelism — ``parallel.mesh.shard_sparse_batch``).
     """

@@ -94,12 +94,15 @@ class FixedEffectCoordinate(Coordinate):
         base = self.batch
         from photon_ml_tpu.data.batch import SparseBatch
 
-        if isinstance(base, SparseBatch) and base.colmajor is not None:
-            # The transposed-ELL copy indexes *all* rows; subsetting its
-            # virtual-row arrays by example ids would silently corrupt
-            # X^T r.  Drop it — the subsetted batch falls back to the
-            # segment-sum path (down-sampled solves are smaller anyway).
-            base = base.replace(colmajor=None)
+        if isinstance(base, SparseBatch) and (
+            base.colmajor is not None or base.grr is not None
+        ):
+            # The transposed-ELL / GRR plans index *all* rows;
+            # subsetting their layout arrays by example ids would
+            # silently corrupt X^T r.  Drop them — the subsetted batch
+            # falls back to the ELL paths (down-sampled solves are
+            # smaller anyway).
+            base = base.replace(colmajor=None, grr=None)
         sub = jax.tree.map(lambda a: a[self.train_idx], base)
         return sub.replace(offsets=offsets[self.train_idx],
                            weights=self.train_weights)

@@ -103,6 +103,11 @@ def lib() -> "ctypes.CDLL | None":
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
         ]
+        dll.pml_grr_routes.restype = ctypes.c_int32
+        dll.pml_grr_routes.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
         _lib = dll
         return dll
 
@@ -164,6 +169,28 @@ def edge_color_native(
     if rc != 0:
         raise ValueError("pml_edge_color: invalid arguments")
     return color
+
+
+def grr_routes_native(dst: np.ndarray, hi: np.ndarray):
+    """Batched GRR supertile routing → (g1, g2, g3) int8 arrays, or None
+    when the native library is unavailable (Python fallback in
+    ``data.grr``).  ``dst``: [n_st,128,128] int32 slot bijections;
+    ``hi``: [n_st,128,128] int8 gather planes.  Raises ValueError if a
+    tile is not a bijection."""
+    dll = lib()
+    if dll is None:
+        return None
+    dst = np.ascontiguousarray(dst, np.int32)
+    hi = np.ascontiguousarray(hi, np.int8)
+    n_st = dst.shape[0]
+    g1 = np.empty_like(hi)
+    g2 = np.empty_like(hi)
+    g3 = np.empty_like(hi)
+    rc = dll.pml_grr_routes(_ptr(dst), _ptr(hi), n_st, _ptr(g1), _ptr(g2),
+                            _ptr(g3))
+    if rc != 0:
+        raise ValueError("pml_grr_routes: dst tile is not a bijection")
+    return g1, g2, g3
 
 
 def colmajor_build_native(

@@ -283,6 +283,14 @@ int32_t pml_edge_color(const int32_t* src, const int32_t* dst, int64_t m,
                        int32_t n_left, int32_t n_right, int32_t n_colors,
                        int32_t* color) {
   if (n_colors <= 0 || (n_colors & (n_colors - 1)) != 0) return -1;
+  if (m < 0 || n_left <= 0 || n_right <= 0) return -1;
+  // Vertex-range validation before touching the adjacency arrays: an
+  // out-of-range id would index head/nxt/prv out of bounds (heap
+  // corruption reachable from Python via edge_color_native).
+  for (int64_t e = 0; e < m; ++e) {
+    if (src[e] < 0 || src[e] >= n_left || dst[e] < 0 || dst[e] >= n_right)
+      return -1;
+  }
   std::vector<int64_t> edge_ids(static_cast<size_t>(m));
   for (int64_t e = 0; e < m; ++e) { edge_ids[e] = e; color[e] = 0; }
   std::vector<int64_t> head(static_cast<size_t>(n_left + n_right));
@@ -317,6 +325,53 @@ int32_t pml_edge_color(const int32_t* src, const int32_t* dst, int64_t m,
       next_ranges.emplace_back(mid, hi);
     }
     ranges = std::move(next_ranges);
+  }
+  return 0;
+}
+
+// Batched GRR route builder: for each [128,128] supertile, color the
+// start→final slot permutation (dst[t][r*128+l] = final slot of the
+// element starting at (r, l)) and emit the three lane-gather stages the
+// kernel executes (ops/grr_kernel.py), with route stage 1 pre-composed
+// with the gather index plane hi.  This is the hot part of compiling a
+// sparse matrix into the GRR plan (data/grr.py) — one Euler-split
+// coloring per supertile, O(slots · log 128) each.
+// Returns 0, or -1 if any tile's dst is not a bijection / coloring
+// arguments are invalid.
+int32_t pml_grr_routes(const int32_t* dst, const int8_t* hi, int64_t n_st,
+                       int8_t* g1, int8_t* g2, int8_t* g3) {
+  constexpr int32_t T = 128;
+  constexpr int64_t S = static_cast<int64_t>(T) * T;
+  std::vector<int32_t> src_row(S), dst_row(S), color(S);
+  std::vector<uint8_t> seen(S);
+  for (int64_t e = 0; e < S; ++e) src_row[e] = static_cast<int32_t>(e >> 7);
+
+  for (int64_t t = 0; t < n_st; ++t) {
+    const int32_t* d = dst + t * S;
+    const int8_t* h = hi + t * S;
+    std::memset(seen.data(), 0, static_cast<size_t>(S));
+    for (int64_t e = 0; e < S; ++e) {
+      const int32_t v = d[e];
+      if (v < 0 || v >= S || seen[v]) return -1;
+      seen[v] = 1;
+      dst_row[e] = v >> 7;
+    }
+    if (pml_edge_color(src_row.data(), dst_row.data(), S, T, T, T,
+                       color.data()) != 0)
+      return -1;
+    int8_t* G1 = g1 + t * S;
+    int8_t* G2 = g2 + t * S;
+    int8_t* G3 = g3 + t * S;
+    for (int64_t e = 0; e < S; ++e) {
+      const int32_t r = src_row[e];
+      const int32_t l = static_cast<int32_t>(e & (T - 1));
+      const int32_t c = color[e];
+      const int32_t dr = dst_row[e];
+      const int32_t dl = d[e] & (T - 1);
+      G1[r * T + c] = h[r * T + l];
+      G2[c * T + dr] = static_cast<int8_t>(r);
+      G3[dr * T + dl] = static_cast<int8_t>(c);
+    }
   }
   return 0;
 }

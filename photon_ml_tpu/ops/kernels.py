@@ -51,23 +51,14 @@ def gather_rowsum(table: Array, vals: Array, ids: Array) -> Array:
     return _xla_gather_rowsum(table, vals, ids)
 
 
-def round_up_rows(n_rows: int) -> int:
-    """Smallest tile-friendly row count ≥ ``n_rows``: a multiple of 1024
-    for large arrays, of 8 (the f32 sublane count) for small ones.
-    Callers that want whole-tile grids over row-blocked arrays pad with
-    this; padding rows are masked/zero-valued."""
-    m = 1024 if n_rows > 8192 else 8
-    return -(-n_rows // m) * m
-
-
-def vrow_pad(v: int, multiple: int | None) -> int:
-    """Padded virtual-row count for the transposed-ELL build: explicit
-    ``multiple`` when given, else ``round_up_rows``.  The single source
-    of truth shared by the numpy and native colmajor builders (their
-    outputs must stay byte-identical)."""
+def vrow_pad(v: int, multiple: int | None = None) -> int:
+    """Padded virtual-row count for the transposed-ELL build (multiple
+    of 8 — the f32 sublane count — unless an explicit multiple is
+    given).  The single source of truth shared by the numpy and native
+    colmajor builders (their outputs must stay byte-identical)."""
     v = max(int(v), 1)
     if multiple is None:
-        return round_up_rows(v)
+        multiple = 8
     return max(-(-v // multiple) * multiple, 8)
 
 

@@ -166,7 +166,9 @@ def _elementwise_square_batch(batch: Batch) -> Batch:
         return batch.replace(x=batch.x * batch.x)
     assert isinstance(batch, SparseBatch)
     cm = batch.colmajor.squared() if batch.colmajor is not None else None
-    return batch.replace(values=batch.values * batch.values, colmajor=cm)
+    pair = batch.grr.squared() if batch.grr is not None else None
+    return batch.replace(values=batch.values * batch.values, colmajor=cm,
+                         grr=pair)
 
 
 class ObjectiveFns(NamedTuple):

@@ -62,12 +62,15 @@ def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
     """
     from photon_ml_tpu.data.batch import SparseBatch
 
-    if isinstance(batch, SparseBatch) and batch.colmajor is not None:
+    if isinstance(batch, SparseBatch) and (
+        batch.colmajor is not None or batch.grr is not None
+    ):
         raise ValueError(
-            "cannot shard a SparseBatch whose colmajor transpose was "
-            "built globally: trows index the whole batch, but each "
-            "device shard sees only its local residuals.  Build with "
-            "shard_sparse_batch(...) instead (per-shard transposes)."
+            "cannot shard a SparseBatch whose colmajor/GRR layout was "
+            "built globally: its index arrays reference the whole "
+            "batch, but each device shard sees only its local "
+            "residuals.  Build with shard_sparse_batch(...) instead, "
+            "which constructs per-shard layouts."
         )
     n = batch.n_padded
     n_dev = mesh.devices.size
