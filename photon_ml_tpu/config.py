@@ -151,6 +151,10 @@ class TrainingConfig:
     resume: bool = False                   # resume from latest checkpoint
     intercept: bool = True
     seed: int = 0
+    # Sparse fixed-effect batch layout: AUTO picks the GRR compiled plan
+    # (data/grr.py — the fast TPU path) on TPU backends and plain ELL
+    # elsewhere; GRR/COLMAJOR/ELL force a specific layout.
+    sparse_layout: str = "AUTO"
 
     def validate(self) -> None:
         names = [c.name for c in self.coordinates]
@@ -181,6 +185,8 @@ class TrainingConfig:
             raise ValueError("n_iterations must be positive")
         if self.model_output_mode not in ("ALL", "BEST", "EXPLICIT"):
             raise ValueError("model_output_mode must be ALL|BEST|EXPLICIT")
+        if self.sparse_layout not in ("AUTO", "GRR", "COLMAJOR", "ELL"):
+            raise ValueError("sparse_layout must be AUTO|GRR|COLMAJOR|ELL")
         for name, grid in self.reg_weight_grid.items():
             if name not in names:
                 raise ValueError(f"grid entry '{name}' unknown")

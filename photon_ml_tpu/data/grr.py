@@ -296,6 +296,12 @@ def build_grr_direction(
     if native is not None:
         G1, G2, G3 = native
     else:
+        if n_st > 64:
+            logger.warning(
+                "GRR: routing %d supertiles with the pure-Python colorer "
+                "(native library unavailable) — this is orders of "
+                "magnitude slower than the C++ path", n_st,
+            )
         G1 = np.empty((n_st, TILE, TILE), np.int8)
         G2 = np.empty((n_st, TILE, TILE), np.int8)
         G3 = np.empty((n_st, TILE, TILE), np.int8)

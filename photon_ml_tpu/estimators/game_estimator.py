@@ -157,7 +157,19 @@ class GameEstimator:
                 ]
                 intercept_index = dim
                 dim += 1
-            batch = make_sparse_batch(rows, dim, labels, weights=weights)
+            # Layout: the GRR compiled plan is the fast TPU path (the
+            # intercept column lands on its dense MXU side); plain ELL
+            # elsewhere (see data/grr.py).
+            layout = cfg.sparse_layout
+            if layout == "AUTO":
+                import jax
+
+                layout = "GRR" if jax.default_backend() == "tpu" else "ELL"
+            batch = make_sparse_batch(
+                rows, dim, labels, weights=weights,
+                grr=(layout == "GRR"),
+                col_major=(layout == "COLMAJOR"),
+            )
 
         norm = NormalizationContext.identity()
         if cfg.normalization != NormalizationType.NONE:

@@ -53,6 +53,120 @@ from photon_ml_tpu.parallel.distributed_objective import DistributedGLMObjective
 Array = jax.Array
 
 
+# ---------------------------------------------------------------------------
+# Module-level jitted solves.  Everything data-like (batch, objective with
+# its traced reg/norm arrays, offsets, warm starts) is a TRACED argument;
+# only the optimizer type and config are static.  Two consequences, both
+# verdict findings from round 2:
+#   * grid/tuning points that differ only in reg weight λ hit the SAME
+#     compiled executable (λ lives in RegularizationContext leaves);
+#   * the batch is never closed over as a jit constant — a constant batch
+#     would be baked into the HLO and shipped through the compiler, which
+#     at production sizes means gigabytes through the compile path.
+# ---------------------------------------------------------------------------
+
+
+def _apply_training_view(batch, offsets: Array, train_idx, train_weights):
+    """Offsets installed; optionally the down-sampled row view."""
+    if train_idx is None:
+        return batch.replace(offsets=offsets)
+    from photon_ml_tpu.data.batch import SparseBatch
+
+    base = batch
+    if isinstance(base, SparseBatch) and (
+        base.colmajor is not None or base.grr is not None
+    ):
+        # The transposed-ELL / GRR plans index *all* rows; subsetting
+        # their layout arrays by example ids would silently corrupt
+        # X^T r.  Drop them — the subsetted batch falls back to the ELL
+        # paths (down-sampled solves are smaller anyway).
+        base = base.replace(colmajor=None, grr=None)
+    sub = jax.tree.map(lambda a: a[train_idx], base)
+    return sub.replace(offsets=offsets[train_idx], weights=train_weights)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _fixed_train_local(optimizer, config, objective, batch, offsets,
+                       train_idx, train_weights, w0):
+    problem = OptimizationProblem(
+        objective=objective, optimizer=optimizer, config=config
+    )
+    view = _apply_training_view(batch, offsets, train_idx, train_weights)
+    return problem.run(view, w0)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _fixed_train_distributed(optimizer, config, dist_obj, batch, offsets,
+                             train_idx, train_weights, w0):
+    from photon_ml_tpu.optim.base import OptimizerType
+
+    view = _apply_training_view(batch, offsets, train_idx, train_weights)
+    vg = lambda w: dist_obj.value_and_gradient(w, view)
+    if optimizer == OptimizerType.TRON:
+        hvp = lambda w, v: dist_obj.hessian_vector(w, v, view)
+        return tron_solve(vg, hvp, w0, config)
+    problem = OptimizationProblem(
+        objective=dist_obj.objective, optimizer=optimizer, config=config
+    )
+    return lbfgs_solve(vg, w0, config,
+                       l1_weight=problem._l1_vector(w0.shape[-1]))
+
+
+@jax.jit
+def _score_batch(batch, w: Array) -> Array:
+    return batch.x_dot(w)
+
+
+def _re_block_batch(blocks, b: int, offsets: Array) -> DenseBatch:
+    """Bucket b's entity blocks as one vmappable DenseBatch, with
+    per-example offsets scattered into block space."""
+    (x_blocks, label_blocks, weight_blocks, mask_blocks,
+     ex_idx, row_idx, col_idx) = blocks
+    off_blk = jnp.zeros_like(label_blocks[b]).at[
+        row_idx[b], col_idx[b]
+    ].set(offsets[ex_idx[b]])
+    return DenseBatch(
+        x=x_blocks[b], labels=label_blocks[b], weights=weight_blocks[b],
+        offsets=off_blk, mask=mask_blocks[b],
+    )
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _re_train(optimizer, config, objective, blocks, offsets: Array,
+              w0s: list[Array]):
+    problem = OptimizationProblem(
+        objective=objective, optimizer=optimizer, config=config
+    )
+    return [
+        jax.vmap(problem.run)(_re_block_batch(blocks, b, offsets), w0s[b])
+        for b in range(len(blocks[0]))
+    ]
+
+
+@partial(jax.jit, static_argnums=0)
+def _re_score(n_examples: int, x_blocks, ex_idx, row_idx, col_idx,
+              coefficient_blocks) -> Array:
+    scores = jnp.zeros((n_examples,), jnp.float32)
+    for b, w_b in enumerate(coefficient_blocks):
+        blk_scores = jnp.einsum("ecp,ep->ec", x_blocks[b], w_b)
+        scores = scores.at[ex_idx[b]].set(
+            blk_scores[row_idx[b], col_idx[b]]
+        )
+    return scores
+
+
+@jax.jit
+def _re_variances(objective, blocks, coefficient_blocks, offsets: Array):
+    from photon_ml_tpu.optim.variance import simple_variances
+
+    return [
+        jax.vmap(
+            lambda w, bb: simple_variances(objective, w, bb)
+        )(w_b, _re_block_batch(blocks, b, offsets))
+        for b, w_b in enumerate(coefficient_blocks)
+    ]
+
+
 class Coordinate:
     """train/score contract (reference ``Coordinate`` abstraction)."""
 
@@ -89,50 +203,27 @@ class FixedEffectCoordinate(Coordinate):
         return jnp.zeros((self.batch.dim,), jnp.float32)
 
     def _training_batch(self, offsets: Array) -> Batch:
-        if self.train_idx is None:
-            return self.batch.replace(offsets=offsets)
-        base = self.batch
-        from photon_ml_tpu.data.batch import SparseBatch
-
-        if isinstance(base, SparseBatch) and (
-            base.colmajor is not None or base.grr is not None
-        ):
-            # The transposed-ELL / GRR plans index *all* rows;
-            # subsetting their layout arrays by example ids would
-            # silently corrupt X^T r.  Drop them — the subsetted batch
-            # falls back to the ELL paths (down-sampled solves are
-            # smaller anyway).
-            base = base.replace(colmajor=None, grr=None)
-        sub = jax.tree.map(lambda a: a[self.train_idx], base)
-        return sub.replace(offsets=offsets[self.train_idx],
-                           weights=self.train_weights)
-
-    @partial(jax.jit, static_argnums=0)
-    def _train_jit(self, offsets: Array, w0: Array):
-        batch = self._training_batch(offsets)
-        if self.distributed is None:
-            return self.problem.run(batch, w0)
-        # Same solver over the psum-reduced objective.
-        obj = self.distributed
-        vg = lambda w: obj.value_and_gradient(w, batch)
-        from photon_ml_tpu.optim.base import OptimizerType
-
-        if self.problem.optimizer == OptimizerType.TRON:
-            hvp = lambda w, v: obj.hessian_vector(w, v, batch)
-            return tron_solve(vg, hvp, w0, self.problem.config)
-        return lbfgs_solve(
-            vg, w0, self.problem.config,
-            l1_weight=self.problem._l1_vector(w0.shape[-1]),
-        )
+        return _apply_training_view(self.batch, offsets, self.train_idx,
+                                    self.train_weights)
 
     def train(self, offsets: Array, warm_start: Array | None = None):
         w0 = self.initial_coefficients() if warm_start is None else warm_start
-        res = self._train_jit(offsets, w0)
+        if self.distributed is None:
+            res = _fixed_train_local(
+                self.problem.optimizer, self.problem.config,
+                self.problem.objective, self.batch, offsets,
+                self.train_idx, self.train_weights, w0,
+            )
+        else:
+            res = _fixed_train_distributed(
+                self.problem.optimizer, self.problem.config,
+                self.distributed, self.batch, offsets,
+                self.train_idx, self.train_weights, w0,
+            )
         return res.w, res
 
-    @partial(jax.jit, static_argnums=0)
     def score(self, coefficients: Array) -> Array:
-        return self.batch.x_dot(coefficients)
+        return _score_batch(self.batch, coefficients)
 
     def as_model(self, coefficients: Array) -> FixedEffectModel:
         return FixedEffectModel(
@@ -180,40 +271,23 @@ class RandomEffectCoordinate(Coordinate):
             for blk in self.x_blocks
         ]
 
-    @partial(jax.jit, static_argnums=0)
-    def _train_jit(self, offsets: Array, w0s: list[Array]):
-        outs = []
-        for b in range(len(self.x_blocks)):
-            off_blk = jnp.zeros_like(self.label_blocks[b]).at[
-                self.row_idx[b], self.col_idx[b]
-            ].set(offsets[self.ex_idx[b]])
-            batch_b = DenseBatch(
-                x=self.x_blocks[b],
-                labels=self.label_blocks[b],
-                weights=self.weight_blocks[b],
-                offsets=off_blk,
-                mask=self.mask_blocks[b],
-            )
-            res = jax.vmap(self.problem.run)(batch_b, w0s[b])
-            outs.append(res)
-        return outs
+    def _blocks(self):
+        return (self.x_blocks, self.label_blocks, self.weight_blocks,
+                self.mask_blocks, self.ex_idx, self.row_idx, self.col_idx)
 
     def train(self, offsets: Array, warm_start=None):
         w0s = self.initial_coefficients() if warm_start is None else warm_start
-        results = self._train_jit(offsets, w0s)
+        results = _re_train(
+            self.problem.optimizer, self.problem.config,
+            self.problem.objective, self._blocks(), offsets, w0s,
+        )
         return [r.w for r in results], results
 
-    @partial(jax.jit, static_argnums=0)
     def score(self, coefficient_blocks: list[Array]) -> Array:
         """Block-space scoring: x·w per entity block, gathered back to
         example order (works for projected and unprojected widths)."""
-        scores = jnp.zeros((self.n_examples,), jnp.float32)
-        for b, w_b in enumerate(coefficient_blocks):
-            blk_scores = jnp.einsum("ecp,ep->ec", self.x_blocks[b], w_b)
-            scores = scores.at[self.ex_idx[b]].set(
-                blk_scores[self.row_idx[b], self.col_idx[b]]
-            )
-        return scores
+        return _re_score(self.n_examples, self.x_blocks, self.ex_idx,
+                         self.row_idx, self.col_idx, coefficient_blocks)
 
     def as_model(self, coefficient_blocks: list[Array]) -> RandomEffectModel:
         return RandomEffectModel(
@@ -223,31 +297,13 @@ class RandomEffectCoordinate(Coordinate):
             projection=self.projection,
         )
 
-    @partial(jax.jit, static_argnums=0)
     def compute_variance_blocks(
         self, coefficient_blocks: list[Array], offsets: Array
     ) -> list[Array]:
         """SIMPLE per-entity variances (1/diag H), vmapped per bucket —
         the per-entity arm of the reference's variance pipeline."""
-        from photon_ml_tpu.optim.variance import simple_variances
-
-        out = []
-        for b, w_b in enumerate(coefficient_blocks):
-            off_blk = jnp.zeros_like(self.label_blocks[b]).at[
-                self.row_idx[b], self.col_idx[b]
-            ].set(offsets[self.ex_idx[b]])
-            batch_b = DenseBatch(
-                x=self.x_blocks[b],
-                labels=self.label_blocks[b],
-                weights=self.weight_blocks[b],
-                offsets=off_blk,
-                mask=self.mask_blocks[b],
-            )
-            out.append(jax.vmap(
-                lambda w, bb: simple_variances(
-                    self.problem.objective, w, bb)
-            )(w_b, batch_b))
-        return out
+        return _re_variances(self.problem.objective, self._blocks(),
+                             coefficient_blocks, offsets)
 
 
 def build_random_effect_coordinate(

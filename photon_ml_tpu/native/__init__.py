@@ -41,7 +41,11 @@ def _build() -> bool:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=120
         )
-    except (OSError, subprocess.TimeoutExpired):
+    except (OSError, subprocess.TimeoutExpired) as e:
+        sys.stderr.write(
+            f"photon_ml_tpu.native: g++ unavailable ({e!r}); using the "
+            "numpy fallbacks (GRR plan compilation will be much slower)\n"
+        )
         return False
     if proc.returncode != 0:
         sys.stderr.write(

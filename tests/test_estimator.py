@@ -143,3 +143,43 @@ def test_config_validation_rejects_bad():
     cfg2.coordinates[0].optimizer.optimizer = OptimizerType.TRON
     with pytest.raises(ValueError, match="TRON"):
         cfg2.validate()
+
+
+def test_grid_points_share_one_compilation():
+    """Round-2 verdict: grid/tuning points differing only in reg weight
+    must not retrace the coordinate solve (λ is a traced leaf)."""
+    import numpy as np
+
+    from photon_ml_tpu.config import (
+        CoordinateConfig,
+        CoordinateKind,
+        OptimizerSettings,
+        TrainingConfig,
+    )
+    from photon_ml_tpu.estimators.game_estimator import GameEstimator
+    from photon_ml_tpu.game.coordinates import _fixed_train_local
+    from photon_ml_tpu.game.dataset import GameDataset
+    from photon_ml_tpu.models.glm import TaskType
+
+    rng = np.random.default_rng(0)
+    n, d = 200, 12
+    x = rng.normal(0, 1, (n, d)).astype(np.float32)
+    y = (x @ rng.normal(0, 1, d) > 0).astype(np.float32)
+    ds = GameDataset(labels=y, features={"global": x}, entity_ids={})
+    cfg = TrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinates=[CoordinateConfig(
+            name="fixed", kind=CoordinateKind.FIXED_EFFECT,
+            feature_shard="global",
+            optimizer=OptimizerSettings(max_iters=15),
+        )],
+        update_sequence=["fixed"],
+        evaluators=[],
+        reg_weight_grid={"fixed": [0.1, 1.0, 10.0, 100.0]},
+    )
+    est = GameEstimator(cfg)
+    before = _fixed_train_local._cache_size()
+    results = est.fit(ds)
+    assert len(results) == 4
+    added = _fixed_train_local._cache_size() - before
+    assert added <= 1, f"grid retraced the solve {added} times"
