@@ -64,7 +64,9 @@ def _grr_stream_bytes(pair) -> int:
         slots = d_.n_supertiles * 16384
         total += slots * (4 + 3)                      # vals + g1/g2/g3
         total += d_.n_spill * 12                      # spill idx/seg/val
-        total += d_.n_gw * 16384 * 4                  # table windows
+        # One [128,128] table window is (re)streamed per supertile (the
+        # kernel fetches the block its gw index selects each grid step).
+        total += d_.n_supertiles * 16384 * 4
     total += int(np.prod(pair.x_hot.shape)) * 4 * 2   # dense side, 2 dirs
     return total
 

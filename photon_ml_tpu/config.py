@@ -155,6 +155,12 @@ class TrainingConfig:
     # (data/grr.py — the fast TPU path) on TPU backends and plain ELL
     # elsewhere; GRR/COLMAJOR/ELL force a specific layout.
     sparse_layout: str = "AUTO"
+    # Device-mesh training (reference: the Spark cluster; SURVEY §3.1):
+    # when set, fixed-effect batches are example-sharded over an
+    # n_devices data mesh with the psum-reduced objective, and
+    # random-effect bucket blocks are entity-sharded (strategy #2).
+    # None = single device.
+    n_devices: int | None = None
 
     def validate(self) -> None:
         names = [c.name for c in self.coordinates]
@@ -187,6 +193,23 @@ class TrainingConfig:
             raise ValueError("model_output_mode must be ALL|BEST|EXPLICIT")
         if self.sparse_layout not in ("AUTO", "GRR", "COLMAJOR", "ELL"):
             raise ValueError("sparse_layout must be AUTO|GRR|COLMAJOR|ELL")
+        if self.n_devices is not None:
+            if self.n_devices <= 0:
+                raise ValueError("n_devices must be positive")
+            if self.sparse_layout not in ("AUTO", "COLMAJOR"):
+                raise ValueError(
+                    f"sparse_layout={self.sparse_layout} is not available "
+                    "with mesh training (n_devices): sharded batches use "
+                    "per-shard COLMAJOR layouts (the GRR plan is not yet "
+                    "mesh-sharded)"
+                )
+            for c in self.coordinates:
+                if c.down_sampling_rate is not None:
+                    raise ValueError(
+                        "down-sampling is not supported with mesh "
+                        "training (n_devices); the row subset would "
+                        "cross shard boundaries"
+                    )
         for name, grid in self.reg_weight_grid.items():
             if name not in names:
                 raise ValueError(f"grid entry '{name}' unknown")

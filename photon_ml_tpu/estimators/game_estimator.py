@@ -112,6 +112,8 @@ class GameEstimator:
         self.config = config
         self.task = config.task_type
         self.loss = self.task.loss
+        self._mesh_cache = None
+        self._entity_mesh_cache = None
         self._warm_model = None
         if config.warm_start_model_dir:
             from photon_ml_tpu.io.model_io import load_game_model
@@ -132,11 +134,30 @@ class GameEstimator:
                 prep[coord_cfg.name] = self._prepare_fixed(train, coord_cfg)
         return prep
 
+    def _mesh(self):
+        if self.config.n_devices is None:
+            return None
+        if self._mesh_cache is None:
+            from photon_ml_tpu.parallel import data_parallel_mesh
+
+            self._mesh_cache = data_parallel_mesh(self.config.n_devices)
+        return self._mesh_cache
+
+    def _entity_mesh(self):
+        if self.config.n_devices is None:
+            return None
+        if self._entity_mesh_cache is None:
+            from photon_ml_tpu.parallel.mesh import entity_mesh
+
+            self._entity_mesh_cache = entity_mesh(self.config.n_devices)
+        return self._entity_mesh_cache
+
     def _prepare_fixed(self, train: GameDataset, coord_cfg: CoordinateConfig):
         cfg = self.config
         feats = train.features[coord_cfg.feature_shard]
         labels = train.labels.astype(np.float32)
         weights = train.weight_array()
+        mesh = self._mesh()
 
         intercept_index = None
         if isinstance(feats, np.ndarray):
@@ -144,7 +165,16 @@ class GameEstimator:
             if cfg.intercept:
                 x = np.concatenate([x, np.ones((len(x), 1), np.float32)], 1)
                 intercept_index = x.shape[1] - 1
-            batch = make_dense_batch(x, labels, weights=weights)
+            if mesh is not None:
+                from photon_ml_tpu.parallel import padded_rows, shard_batch
+
+                batch = make_dense_batch(
+                    x, labels, weights=weights,
+                    pad_to=padded_rows(len(x), mesh.devices.size),
+                )
+                batch = shard_batch(batch, mesh)
+            else:
+                batch = make_dense_batch(x, labels, weights=weights)
             dim = x.shape[1]
         else:  # sparse rows
             dim = train.feature_dim(coord_cfg.feature_shard)
@@ -157,19 +187,32 @@ class GameEstimator:
                 ]
                 intercept_index = dim
                 dim += 1
-            # Layout: the GRR compiled plan is the fast TPU path (the
-            # intercept column lands on its dense MXU side); plain ELL
-            # elsewhere (see data/grr.py).
-            layout = cfg.sparse_layout
-            if layout == "AUTO":
-                import jax
+            if mesh is not None:
+                # Mesh path: per-shard layouts (each device's transposed
+                # copy indexes its own rows; SURVEY §5.8's one-time
+                # "shuffle").  The GRR plan is not yet mesh-sharded —
+                # colmajor is the sharded layout.
+                from photon_ml_tpu.parallel import shard_sparse_batch
 
-                layout = "GRR" if jax.default_backend() == "tpu" else "ELL"
-            batch = make_sparse_batch(
-                rows, dim, labels, weights=weights,
-                grr=(layout == "GRR"),
-                col_major=(layout == "COLMAJOR"),
-            )
+                batch = shard_sparse_batch(
+                    rows, dim, labels, mesh, weights=weights,
+                    col_major=True,
+                )
+            else:
+                # Layout: the GRR compiled plan is the fast TPU path
+                # (the intercept column lands on its dense MXU side);
+                # plain ELL elsewhere (see data/grr.py).
+                layout = cfg.sparse_layout
+                if layout == "AUTO":
+                    import jax
+
+                    layout = ("GRR" if jax.default_backend() == "tpu"
+                              else "ELL")
+                batch = make_sparse_batch(
+                    rows, dim, labels, weights=weights,
+                    grr=(layout == "GRR"),
+                    col_major=(layout == "COLMAJOR"),
+                )
 
         norm = NormalizationContext.identity()
         if cfg.normalization != NormalizationType.NONE:
@@ -197,6 +240,7 @@ class GameEstimator:
             "batch": batch, "norm": norm, "dim": dim,
             "intercept_index": intercept_index,
             "train_idx": train_idx, "train_weights": train_weights,
+            "mesh": mesh, "n_examples": train.n,
         }
 
     # -- warm-start import (saved raw-space model → training space) --------
@@ -299,6 +343,12 @@ class GameEstimator:
                     norm=p["norm"],
                     prior=prior,
                 )
+                distributed = None
+                if p["mesh"] is not None:
+                    from photon_ml_tpu.parallel import DistributedGLMObjective
+
+                    distributed = DistributedGLMObjective(
+                        objective=objective, mesh=p["mesh"])
                 coords[coord_cfg.name] = FixedEffectCoordinate(
                     name=coord_cfg.name,
                     batch=p["batch"],
@@ -307,8 +357,10 @@ class GameEstimator:
                         optimizer=coord_cfg.optimizer.optimizer,
                         config=ocfg,
                     ),
+                    distributed=distributed,
                     train_idx=p["train_idx"],
                     train_weights=p["train_weights"],
+                    n_examples=p["n_examples"],
                 )
             else:
                 feats = train.features[coord_cfg.feature_shard]
@@ -317,11 +369,13 @@ class GameEstimator:
                     reg=_reg_context(coord_cfg.optimizer, weight, 1, None),
                     norm=NormalizationContext.identity(),
                 )
+                e_mesh = self._entity_mesh()
                 if isinstance(feats, np.ndarray):
                     coords[coord_cfg.name] = build_random_effect_coordinate(
                         coord_cfg.entity_key, train, coord_cfg.feature_shard,
                         objective, config=ocfg,
                         optimizer=coord_cfg.optimizer.optimizer,
+                        mesh=e_mesh,
                     )
                 else:
                     coords[coord_cfg.name] = (
@@ -332,6 +386,7 @@ class GameEstimator:
                                 coord_cfg.feature_shard),
                             config=ocfg,
                             optimizer=coord_cfg.optimizer.optimizer,
+                            mesh=e_mesh,
                         )
                     )
                 # Coordinate was registered under entity_key by the

@@ -108,6 +108,7 @@ def run_coordinate_descent(
                              "and is not locked")
 
     start_iteration = 0
+    ckpt_scores: dict = {}
     if resume:
         if not checkpoint_dir:
             raise ValueError("resume=True requires checkpoint_dir")
@@ -115,7 +116,7 @@ def run_coordinate_descent(
 
         loaded = load_latest_checkpoint(checkpoint_dir)
         if loaded is not None:
-            start_iteration, ckpt_coefs = loaded
+            start_iteration, ckpt_coefs, ckpt_scores = loaded
             initial_coefficients.update(ckpt_coefs)
             if run_logger is not None:
                 run_logger.event("cd_resume", iteration=start_iteration)
@@ -133,7 +134,12 @@ def run_coordinate_descent(
     for name in update_sequence:
         if name in locked_coordinates:
             continue
-        if name in initial_coefficients:
+        if name in ckpt_scores and name in initial_coefficients:
+            # Restored score state: bitwise-identical to what the
+            # uninterrupted loop carried at this point.
+            coefs[name] = initial_coefficients[name]
+            scores[name] = ckpt_scores[name]
+        elif name in initial_coefficients:
             coefs[name] = initial_coefficients[name]
             scores[name] = coordinates[name].score(coefs[name])
         else:
@@ -141,9 +147,12 @@ def run_coordinate_descent(
                 coordinates[name].initial_coefficients())
             scores[name] = jnp.zeros_like(s)
 
-    total = None
-    for s in scores.values():
-        total = s if total is None else total + s
+    if "__cd_total__" in ckpt_scores:
+        total = ckpt_scores["__cd_total__"]
+    else:
+        total = None
+        for s in scores.values():
+            total = s if total is None else total + s
 
     history, validation_history = [], []
     for it in range(start_iteration, n_iterations):
@@ -182,7 +191,8 @@ def run_coordinate_descent(
         if checkpoint_dir is not None:
             from photon_ml_tpu.utils.checkpoint import save_checkpoint
 
-            save_checkpoint(checkpoint_dir, it + 1, coefs)
+            save_checkpoint(checkpoint_dir, it + 1, coefs,
+                            scores={**scores, "__cd_total__": total})
 
     return CoordinateDescentResult(
         coefficients=coefs,

@@ -66,8 +66,17 @@ Array = jax.Array
 # ---------------------------------------------------------------------------
 
 
+def _pad_offsets(offsets: Array, n_padded: int) -> Array:
+    """Example-space offsets [n] → batch row space [n_padded] (padding
+    rows are masked, so zeros are exact)."""
+    if offsets.shape[0] == n_padded:
+        return offsets
+    return jnp.pad(offsets, (0, n_padded - offsets.shape[0]))
+
+
 def _apply_training_view(batch, offsets: Array, train_idx, train_weights):
     """Offsets installed; optionally the down-sampled row view."""
+    offsets = _pad_offsets(offsets, batch.n_padded)
     if train_idx is None:
         return batch.replace(offsets=offsets)
     from photon_ml_tpu.data.batch import SparseBatch
@@ -85,31 +94,36 @@ def _apply_training_view(batch, offsets: Array, train_idx, train_weights):
     return sub.replace(offsets=offsets[train_idx], weights=train_weights)
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _fixed_train_local(optimizer, config, objective, batch, offsets,
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _fixed_train_local(optimizer, config, has_l1, objective, batch, offsets,
                        train_idx, train_weights, w0):
     problem = OptimizationProblem(
         objective=objective, optimizer=optimizer, config=config
     )
     view = _apply_training_view(batch, offsets, train_idx, train_weights)
-    return problem.run(view, w0)
+    return problem.run(view, w0, has_l1=has_l1)
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _fixed_train_distributed(optimizer, config, dist_obj, batch, offsets,
-                             train_idx, train_weights, w0):
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _fixed_train_distributed(optimizer, config, has_l1, dist_obj, batch,
+                             offsets, train_idx, train_weights, w0):
     from photon_ml_tpu.optim.base import OptimizerType
 
     view = _apply_training_view(batch, offsets, train_idx, train_weights)
     vg = lambda w: dist_obj.value_and_gradient(w, view)
     if optimizer == OptimizerType.TRON:
+        if has_l1:
+            raise ValueError(
+                "TRON requires a smooth objective; use LBFGS (OWL-QN) "
+                "for L1/elastic-net problems"
+            )
         hvp = lambda w, v: dist_obj.hessian_vector(w, v, view)
         return tron_solve(vg, hvp, w0, config)
     problem = OptimizationProblem(
         objective=dist_obj.objective, optimizer=optimizer, config=config
     )
-    return lbfgs_solve(vg, w0, config,
-                       l1_weight=problem._l1_vector(w0.shape[-1]))
+    l1 = problem._l1_vector(w0.shape[-1]) if has_l1 else None
+    return lbfgs_solve(vg, w0, config, l1_weight=l1)
 
 
 @jax.jit
@@ -131,14 +145,15 @@ def _re_block_batch(blocks, b: int, offsets: Array) -> DenseBatch:
     )
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _re_train(optimizer, config, objective, blocks, offsets: Array,
+@partial(jax.jit, static_argnums=(0, 1, 2))
+def _re_train(optimizer, config, has_l1, objective, blocks, offsets: Array,
               w0s: list[Array]):
     problem = OptimizationProblem(
         objective=objective, optimizer=optimizer, config=config
     )
+    run = partial(problem.run, has_l1=has_l1)
     return [
-        jax.vmap(problem.run)(_re_block_batch(blocks, b, offsets), w0s[b])
+        jax.vmap(run)(_re_block_batch(blocks, b, offsets), w0s[b])
         for b in range(len(blocks[0]))
     ]
 
@@ -198,6 +213,10 @@ class FixedEffectCoordinate(Coordinate):
     # train on batch rows ``train_idx`` with ``train_weights``; score all.
     train_idx: Array | None = None
     train_weights: Array | None = None
+    # Real example count when the batch rows were padded (mesh sharding
+    # pads n to a multiple of the device count); scores are sliced back
+    # to example space so they stay summable with other coordinates'.
+    n_examples: int | None = None
 
     def initial_coefficients(self) -> Array:
         return jnp.zeros((self.batch.dim,), jnp.float32)
@@ -208,22 +227,27 @@ class FixedEffectCoordinate(Coordinate):
 
     def train(self, offsets: Array, warm_start: Array | None = None):
         w0 = self.initial_coefficients() if warm_start is None else warm_start
+        has_l1 = self.problem.has_l1()
         if self.distributed is None:
             res = _fixed_train_local(
-                self.problem.optimizer, self.problem.config,
+                self.problem.optimizer, self.problem.config, has_l1,
                 self.problem.objective, self.batch, offsets,
                 self.train_idx, self.train_weights, w0,
             )
         else:
             res = _fixed_train_distributed(
-                self.problem.optimizer, self.problem.config,
+                self.problem.optimizer, self.problem.config, has_l1,
                 self.distributed, self.batch, offsets,
                 self.train_idx, self.train_weights, w0,
             )
         return res.w, res
 
     def score(self, coefficients: Array) -> Array:
-        return _score_batch(self.batch, coefficients)
+        scores = _score_batch(self.batch, coefficients)
+        if (self.n_examples is not None
+                and self.n_examples != self.batch.n_padded):
+            scores = scores[: self.n_examples]
+        return scores
 
     def as_model(self, coefficients: Array) -> FixedEffectModel:
         return FixedEffectModel(
@@ -234,12 +258,16 @@ class FixedEffectCoordinate(Coordinate):
     def compute_variances(self, coefficients: Array, offsets: Array,
                           variance_type) -> Array | None:
         """Coefficient variances at the optimum over the training view
-        (reference VarianceComputationType pipeline, SURVEY §2.1)."""
+        (reference VarianceComputationType pipeline, SURVEY §2.1).
+
+        Under mesh sharding the distributed objective must aggregate
+        the Hessian quantities (its colmajor row indices are
+        shard-local, and the diagonal is a cross-shard sum)."""
         from photon_ml_tpu.optim.variance import compute_variances
 
+        obj = self.distributed or self.problem.objective
         return compute_variances(
-            self.problem.objective, coefficients,
-            self._training_batch(offsets), variance_type,
+            obj, coefficients, self._training_batch(offsets), variance_type,
         )
 
 
@@ -279,7 +307,8 @@ class RandomEffectCoordinate(Coordinate):
         w0s = self.initial_coefficients() if warm_start is None else warm_start
         results = _re_train(
             self.problem.optimizer, self.problem.config,
-            self.problem.objective, self._blocks(), offsets, w0s,
+            self.problem.has_l1(), self.problem.objective,
+            self._blocks(), offsets, w0s,
         )
         return [r.w for r in results], results
 
@@ -306,6 +335,19 @@ class RandomEffectCoordinate(Coordinate):
                              coefficient_blocks, offsets)
 
 
+def _shard_re_blocks(coord_kwargs: dict, mesh) -> dict:
+    """Entity-shard a coordinate's bucket blocks on the mesh
+    (reference parallelism strategy #2 — per-entity solves are
+    communication-free, so the leading entity axis shards cleanly)."""
+    if mesh is None:
+        return coord_kwargs
+    from photon_ml_tpu.parallel.mesh import shard_entity_blocks
+
+    for key in ("x_blocks", "label_blocks", "weight_blocks", "mask_blocks"):
+        coord_kwargs[key] = shard_entity_blocks(coord_kwargs[key], mesh)
+    return coord_kwargs
+
+
 def build_random_effect_coordinate(
     name: str,
     dataset: GameDataset,
@@ -314,6 +356,7 @@ def build_random_effect_coordinate(
     config: OptimizerConfig | None = None,
     optimizer=None,
     bucket_base: int = 4,
+    mesh=None,
 ) -> RandomEffectCoordinate:
     """Host ETL → device blocks: the reference's partition-and-group
     pipeline (``RandomEffectDataset.apply``) as one deterministic pass."""
@@ -338,6 +381,16 @@ def build_random_effect_coordinate(
         xb = np.zeros((ne, cap, x.shape[1]), np.float32)
         xb[grouping.example_row[sel], grouping.example_col[sel]] = x[sel]
         x_blocks.append(jnp.asarray(xb))
+
+    blocks = _shard_re_blocks(
+        dict(x_blocks=x_blocks, label_blocks=lab_blocks,
+             weight_blocks=wt_blocks, mask_blocks=mask_blocks),
+        mesh,
+    )
+    x_blocks = blocks["x_blocks"]
+    lab_blocks = blocks["label_blocks"]
+    wt_blocks = blocks["weight_blocks"]
+    mask_blocks = blocks["mask_blocks"]
 
     problem = OptimizationProblem(
         objective=objective,
@@ -398,6 +451,7 @@ def build_random_effect_coordinate_sparse(
     config: OptimizerConfig | None = None,
     optimizer=None,
     bucket_base: int = 4,
+    mesh=None,
 ) -> RandomEffectCoordinate:
     """Sparse-shard variant: features arrive as per-example (col_ids,
     values) rows in a wide global space; each entity's problem is solved
@@ -425,10 +479,19 @@ def build_random_effect_coordinate_sparse(
         optimizer=optimizer or OptimizerType.LBFGS,
         config=config or OptimizerConfig(),
     )
+    blocks = _shard_re_blocks(
+        dict(x_blocks=[jnp.asarray(xb) for xb in x_blocks_np],
+             label_blocks=lab_blocks, weight_blocks=wt_blocks,
+             mask_blocks=mask_blocks),
+        mesh,
+    )
+    lab_blocks = blocks["label_blocks"]
+    wt_blocks = blocks["weight_blocks"]
+    mask_blocks = blocks["mask_blocks"]
     return RandomEffectCoordinate(
         name=name,
         grouping=grouping,
-        x_blocks=[jnp.asarray(xb) for xb in x_blocks_np],
+        x_blocks=blocks["x_blocks"],
         label_blocks=lab_blocks,
         weight_blocks=wt_blocks,
         mask_blocks=mask_blocks,

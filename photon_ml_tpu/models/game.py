@@ -113,7 +113,10 @@ class RandomEffectModel:
                         self.coefficient_blocks[0].dtype)
         for b, blk in enumerate(self.coefficient_blocks):
             global_idx = np.where(self.grouping.entity_bucket == b)[0]
-            out = out.at[jnp.asarray(global_idx)].set(blk)
+            # Blocks may carry trailing padding entities (entity-mesh
+            # sharding pads E_b to the device count); real entities
+            # occupy the leading slots.
+            out = out.at[jnp.asarray(global_idx)].set(blk[: len(global_idx)])
         return out
 
 

@@ -55,36 +55,45 @@ class OptimizationProblem:
         pytree_node=False, default_factory=OptimizerConfig
     )
 
-    def _l1_vector(self, dim: int) -> Array | None:
-        reg = self.objective.reg
-        # Static zero-check is impossible on traced values; use the concrete
-        # value when available, else assume present.  In practice reg
-        # weights are concrete floats at problem-construction time.
-        l1 = reg.l1_weight
+    def has_l1(self) -> bool:
+        """Concrete L1-presence — decides L-BFGS vs OWL-QN routing.
+        Must be evaluated OUTSIDE jit (at problem construction the reg
+        weight is a concrete scalar; under trace it is a tracer and the
+        routing, being control flow, cannot depend on it)."""
         try:
-            is_zero = float(l1) == 0.0
-        except (TypeError, jax.errors.TracerArrayConversionError):
-            is_zero = False
-        if is_zero:
-            return None
-        vec = jnp.broadcast_to(jnp.asarray(l1, jnp.float32), (dim,))
+            return float(self.objective.reg.l1_weight) != 0.0
+        except (TypeError, jax.errors.TracerArrayConversionError) as e:
+            raise ValueError(
+                "has_l1 must be decided on a concrete objective; pass "
+                "has_l1= explicitly when calling run() under jit"
+            ) from e
+
+    def _l1_vector(self, dim: int) -> Array:
+        reg = self.objective.reg
+        vec = jnp.broadcast_to(
+            jnp.asarray(reg.l1_weight, jnp.float32), (dim,)
+        )
         if reg.reg_mask is not None:
             vec = vec * reg.reg_mask
         return vec
 
-    def run(self, batch: Batch, w0: Array) -> OptimizationResult:
-        """Solve for one batch from one starting point (jittable)."""
+    def run(self, batch: Batch, w0: Array,
+            has_l1: bool | None = None) -> OptimizationResult:
+        """Solve for one batch from one starting point (jittable; when
+        called under jit, ``has_l1`` must be supplied — see has_l1)."""
         obj = self.objective
         vg = lambda w: obj.value_and_gradient(w, batch)
-        l1 = self._l1_vector(w0.shape[-1])
+        if has_l1 is None:
+            has_l1 = self.has_l1()
         if self.optimizer == OptimizerType.TRON:
-            if l1 is not None:
+            if has_l1:
                 raise ValueError(
                     "TRON requires a smooth objective; use LBFGS (OWL-QN) "
                     "for L1/elastic-net problems"
                 )
             hvp = lambda w, v: obj.hessian_vector(w, v, batch)
             return tron_solve(vg, hvp, w0, self.config)
+        l1 = self._l1_vector(w0.shape[-1]) if has_l1 else None
         return lbfgs_solve(vg, w0, self.config, l1_weight=l1)
 
 

@@ -30,8 +30,7 @@ DATA_AXIS = "data"
 ENTITY_AXIS = "entity"
 
 
-def data_parallel_mesh(n_devices: int | None = None) -> Mesh:
-    """1-D mesh over the first ``n_devices`` devices (default: all)."""
+def _make_mesh(axis: str, n_devices: int | None) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
         if n_devices > len(devs):
@@ -39,7 +38,36 @@ def data_parallel_mesh(n_devices: int | None = None) -> Mesh:
                 f"requested {n_devices} devices, have {len(devs)}"
             )
         devs = devs[:n_devices]
-    return Mesh(np.asarray(devs), (DATA_AXIS,))
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def data_parallel_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (default: all)."""
+    return _make_mesh(DATA_AXIS, n_devices)
+
+
+def entity_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh named for entity sharding (random effects): bucket
+    blocks [E_b, cap, p] shard their leading (entity) axis here —
+    the reference's parallelism strategy #2 (SURVEY §2.3)."""
+    return _make_mesh(ENTITY_AXIS, n_devices)
+
+
+def shard_entity_blocks(blocks: list, mesh: Mesh) -> list:
+    """Pad each bucket's entity count to the mesh size and shard the
+    leading axis on ENTITY_AXIS.  Padding entities carry zero
+    data/mask, so their (vmapped) solves converge immediately and their
+    coefficients are never gathered.  Per-device entity counts are
+    exactly balanced by construction."""
+    n_dev = mesh.devices.size
+    out = []
+    for b in blocks:
+        e = b.shape[0]
+        e_pad = padded_rows(max(e, 1), n_dev)
+        if e_pad != e:
+            b = jnp.pad(b, ((0, e_pad - e),) + ((0, 0),) * (b.ndim - 1))
+        out.append(jax.device_put(b, NamedSharding(mesh, P(ENTITY_AXIS))))
+    return out
 
 
 def batch_spec() -> P:

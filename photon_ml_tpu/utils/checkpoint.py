@@ -50,13 +50,24 @@ def _unflatten(data) -> dict:
     return coefs
 
 
-def save_checkpoint(ckpt_dir: str, iteration: int, coefs: dict) -> str:
-    """Persist state after completed CD iteration ``iteration`` (1-based)."""
+def save_checkpoint(ckpt_dir: str, iteration: int, coefs: dict,
+                    scores: dict | None = None) -> str:
+    """Persist state after completed CD iteration ``iteration`` (1-based).
+
+    ``scores`` (coordinate → [n] array) captures the coordinate-descent
+    score state: restoring it makes a resumed run's offsets *bitwise*
+    equal to the uninterrupted run's (re-scoring from coefficients would
+    rebuild the total as a fresh sum, while the live loop accumulates it
+    incrementally — a float-reordering difference that optimization then
+    amplifies)."""
     os.makedirs(ckpt_dir, exist_ok=True)
     path = os.path.join(ckpt_dir, f"cd_iter_{iteration}.npz")
     tmp = path + ".tmp"
+    arrs = _flatten(coefs)
+    for name, s in (scores or {}).items():
+        arrs[f"{name}__score"] = np.asarray(s)
     with open(tmp, "wb") as f:
-        np.savez(f, **_flatten(coefs))
+        np.savez(f, **arrs)
     os.replace(tmp, path)  # atomic: a crash never leaves a torn "latest"
     with open(os.path.join(ckpt_dir, "latest.tmp"), "w") as f:
         f.write(str(iteration))
@@ -65,8 +76,13 @@ def save_checkpoint(ckpt_dir: str, iteration: int, coefs: dict) -> str:
     return path
 
 
-def load_latest_checkpoint(ckpt_dir: str) -> tuple[int, dict] | None:
-    """(completed_iteration, coefficients) or None if no checkpoint."""
+def load_latest_checkpoint(
+    ckpt_dir: str,
+) -> tuple[int, dict, dict] | None:
+    """(completed_iteration, coefficients, scores) or None.
+
+    ``scores`` is empty for checkpoints written before scores were
+    saved (the caller re-scores from coefficients)."""
     latest = os.path.join(ckpt_dir, "latest")
     if not os.path.exists(latest):
         return None
@@ -74,4 +90,23 @@ def load_latest_checkpoint(ckpt_dir: str) -> tuple[int, dict] | None:
         iteration = int(f.read().strip())
     path = os.path.join(ckpt_dir, f"cd_iter_{iteration}.npz")
     with np.load(path) as data:
-        return iteration, _unflatten(data)
+        scores = {
+            key.rsplit("__", 1)[0]: jnp.asarray(data[key])
+            for key in data.files if key.endswith("__score")
+        }
+        coefs = _unflatten(
+            _NpzView({k: data[k] for k in data.files
+                      if not k.endswith("__score")})
+        )
+        return iteration, coefs, scores
+
+
+class _NpzView:
+    """Minimal files/getitem adapter so _unflatten reads a dict."""
+
+    def __init__(self, data: dict):
+        self._data = data
+        self.files = list(data)
+
+    def __getitem__(self, key):
+        return self._data[key]

@@ -137,7 +137,7 @@ def test_checkpoint_roundtrip(tmp_path):
     assert load_latest_checkpoint(str(tmp_path)) is None
     save_checkpoint(str(tmp_path), 1, coefs)
     save_checkpoint(str(tmp_path), 2, coefs)
-    it, loaded = load_latest_checkpoint(str(tmp_path))
+    it, loaded, _scores = load_latest_checkpoint(str(tmp_path))
     assert it == 2
     np.testing.assert_array_equal(loaded["global"], coefs["global"])
     assert len(loaded["per_user"]) == 2
@@ -308,7 +308,7 @@ def test_checkpoint_resume_matches_uninterrupted(tmp_path):
     cfg_a = _game_config(n_iterations=2,
                          checkpoint_dir=str(tmp_path / "ck"))
     GameEstimator(cfg_a).fit(train)
-    it, _ = load_latest_checkpoint(str(tmp_path / "ck"))
+    it, _, _ = load_latest_checkpoint(str(tmp_path / "ck"))
     assert it == 2
     cfg_b = _game_config(n_iterations=3,
                          checkpoint_dir=str(tmp_path / "ck"), resume=True)

@@ -1,0 +1,169 @@
+"""Mesh-integrated training: the estimator drives the 8-device mesh.
+
+Round-2 verdict items 2+3: the production path (GameEstimator) must
+construct the mesh itself — example-sharded fixed-effect batches with
+the psum-reduced objective (previously dead code), entity-sharded
+random-effect blocks — and match single-device results to tolerance.
+Runs on the virtual 8-device CPU mesh (conftest), the rebuild's
+"Spark local mode" (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from photon_ml_tpu.config import (
+    CoordinateConfig,
+    CoordinateKind,
+    OptimizerSettings,
+    TrainingConfig,
+)
+from photon_ml_tpu.estimators.game_estimator import GameEstimator
+from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.models.glm import TaskType
+from photon_ml_tpu.optim.base import OptimizerType
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _sparse_dataset(rng, n=600, d=40, k=6):
+    w_true = rng.normal(0, 1, d)
+    rows = []
+    y = np.zeros(n, np.float32)
+    for i in range(n):
+        c = np.sort(rng.choice(d, k, replace=False)).astype(np.int32)
+        v = rng.normal(0, 1, k).astype(np.float32)
+        rows.append((c, v))
+        y[i] = 1.0 if v @ w_true[c] + rng.normal(0, 0.3) > 0 else 0.0
+    return GameDataset(labels=y, features={"f": rows}, entity_ids={},
+                       feature_dims={"f": d}), w_true
+
+
+def _game_dataset(rng, n=500, d=8, d_re=3, n_entities=24):
+    x = rng.normal(0, 1, (n, d)).astype(np.float32)
+    x_re = rng.normal(0, 1, (n, d_re)).astype(np.float32)
+    ids = rng.integers(0, n_entities, n)
+    w = rng.normal(0, 1, d)
+    w_re = rng.normal(0, 1.5, (n_entities, d_re))
+    margin = x @ w + np.einsum("nd,nd->n", x_re, w_re[ids])
+    y = (margin + rng.normal(0, 0.3, n) > 0).astype(np.float32)
+    return GameDataset(
+        labels=y, features={"g": x, "per_user": x_re},
+        entity_ids={"user": ids},
+    )
+
+
+def _fixed_cfg(n_devices=None, **kw):
+    return TrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinates=[CoordinateConfig(
+            name="global", kind=CoordinateKind.FIXED_EFFECT,
+            feature_shard="f",
+            optimizer=OptimizerSettings(max_iters=60, reg_weight=1.0),
+        )],
+        update_sequence=["global"],
+        evaluators=[EvaluatorType.AUC],
+        n_devices=n_devices,
+        **kw,
+    )
+
+
+def test_config1_sparse_mesh_matches_single_device(rng):
+    """BASELINE config-1 shape (sparse logistic, L-BFGS, L2) through the
+    estimator: 8-device mesh == single device."""
+    ds, _ = _sparse_dataset(rng)
+    r1 = GameEstimator(_fixed_cfg()).fit(ds, ds)[0]
+    r8 = GameEstimator(_fixed_cfg(n_devices=8)).fit(ds, ds)[0]
+    w1 = np.asarray(r1.model.models["global"].coefficients.means)
+    w8 = np.asarray(r8.model.models["global"].coefficients.means)
+    np.testing.assert_allclose(w8, w1, rtol=5e-3, atol=5e-3)
+    assert abs(r8.evaluations[EvaluatorType.AUC]
+               - r1.evaluations[EvaluatorType.AUC]) < 1e-3
+    assert r8.evaluations[EvaluatorType.AUC] > 0.8
+
+
+def test_config1_tron_mesh_matches_single_device(rng):
+    """TRON over the psum objective (the distributed HVP arm)."""
+    ds, _ = _sparse_dataset(rng, n=400)
+    def cfg(n_devices=None):
+        c = _fixed_cfg(n_devices=n_devices)
+        c.coordinates[0].optimizer.optimizer = OptimizerType.TRON
+        return c
+    r1 = GameEstimator(cfg()).fit(ds)[0]
+    r8 = GameEstimator(cfg(8)).fit(ds)[0]
+    w1 = np.asarray(r1.model.models["global"].coefficients.means)
+    w8 = np.asarray(r8.model.models["global"].coefficients.means)
+    np.testing.assert_allclose(w8, w1, rtol=5e-3, atol=5e-3)
+
+
+def test_config4_game_mesh_matches_single_device(rng):
+    """BASELINE config-4 shape (fixed + per-user random effect) through
+    the estimator on the mesh: entity-sharded RE solves + sharded fixed
+    solve must reproduce the single-device model."""
+    ds = _game_dataset(rng)
+    def cfg(n_devices=None):
+        return TrainingConfig(
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            coordinates=[
+                CoordinateConfig(
+                    name="fixed", kind=CoordinateKind.FIXED_EFFECT,
+                    feature_shard="g",
+                    optimizer=OptimizerSettings(max_iters=40,
+                                                reg_weight=0.5),
+                ),
+                CoordinateConfig(
+                    name="per_user", kind=CoordinateKind.RANDOM_EFFECT,
+                    feature_shard="per_user", entity_key="user",
+                    optimizer=OptimizerSettings(max_iters=40,
+                                                reg_weight=1.0),
+                ),
+            ],
+            update_sequence=["fixed", "per_user"],
+            n_iterations=2,
+            evaluators=[EvaluatorType.AUC],
+            n_devices=n_devices,
+        )
+    r1 = GameEstimator(cfg()).fit(ds, ds)[0]
+    r8 = GameEstimator(cfg(8)).fit(ds, ds)[0]
+    w1 = np.asarray(r1.model.models["fixed"].coefficients.means)
+    w8 = np.asarray(r8.model.models["fixed"].coefficients.means)
+    np.testing.assert_allclose(w8, w1, rtol=1e-2, atol=1e-2)
+    auc1 = r1.evaluations[EvaluatorType.AUC]
+    auc8 = r8.evaluations[EvaluatorType.AUC]
+    assert abs(auc8 - auc1) < 2e-3
+    assert auc8 > 0.85
+    # RE coefficients agree entity by entity
+    m1, m8 = r1.model.models["per_user"], r8.model.models["per_user"]
+    for e in range(24):
+        c1 = m1.coefficients_for(e)
+        c8 = m8.coefficients_for(e)
+        if c1 is None:
+            assert c8 is None
+            continue
+        np.testing.assert_allclose(np.asarray(c8), np.asarray(c1),
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_entity_blocks_balanced_on_mesh(rng):
+    """Per-device entity counts are balanced (padded to equal splits)
+    and the leading axis is sharded on ENTITY_AXIS."""
+    from jax.sharding import NamedSharding
+
+    from photon_ml_tpu.parallel.mesh import ENTITY_AXIS, entity_mesh
+    from photon_ml_tpu.parallel.mesh import shard_entity_blocks
+
+    mesh = entity_mesh(8)
+    blocks = [np.ones((13, 4, 3), np.float32), np.ones((3, 16), np.float32)]
+    sharded = shard_entity_blocks([jax.numpy.asarray(b) for b in blocks],
+                                  mesh)
+    for s in sharded:
+        assert s.shape[0] % 8 == 0
+        assert isinstance(s.sharding, NamedSharding)
+        assert s.sharding.spec[0] == ENTITY_AXIS
