@@ -209,25 +209,38 @@ def make_sparse_batch(
       grr: compile the GRR plan (``data.grr``) — the fast TPU path for
         both contraction directions; supersedes ``col_major`` when set.
     """
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+
     n = len(rows)
-    k = row_capacity or max((len(c) for c, _ in rows), default=1)
-    k = max(k, 1)
-    n_out = max(pad_to or n, n)
-    vals = np.zeros((n_out, k), np.float32)
-    cols = np.zeros((n_out, k), np.int32)
-    for i, (c, v) in enumerate(rows):
-        if len(c) > k:
-            raise ValueError(f"row {i} nnz {len(c)} exceeds capacity {k}")
-        # Duplicate column ids within a row would silently break
-        # hessian_diagonal (which squares values elementwise, so duplicates
-        # give Σv² instead of (Σv)²); reject them at construction time.
-        if len(np.unique(c)) != len(c):
-            raise ValueError(
-                f"row {i} has duplicate column ids; SparseBatch requires "
-                "unique col_ids per row (pre-sum duplicates on the host)"
-            )
-        vals[i, : len(c)] = v
-        cols[i, : len(c)] = c
+    if isinstance(rows, SparseRows):
+        # Scale path: canonical CSR → ELL in one vectorized scatter.
+        # Canonical form already guarantees unique sorted per-row ids
+        # (the invariant hessian_diagonal needs).
+        k = max(row_capacity or rows.max_nnz, 1)
+        n_out = max(pad_to or n, n)
+        cols, vals = rows.to_ell(row_capacity=k, pad_to=n_out)
+    else:
+        k = row_capacity or max((len(c) for c, _ in rows), default=1)
+        k = max(k, 1)
+        n_out = max(pad_to or n, n)
+        vals = np.zeros((n_out, k), np.float32)
+        cols = np.zeros((n_out, k), np.int32)
+        for i, (c, v) in enumerate(rows):
+            if len(c) > k:
+                raise ValueError(
+                    f"row {i} nnz {len(c)} exceeds capacity {k}")
+            # Duplicate column ids within a row would silently break
+            # hessian_diagonal (which squares values elementwise, so
+            # duplicates give Σv² instead of (Σv)²); reject them at
+            # construction time.
+            if len(np.unique(c)) != len(c):
+                raise ValueError(
+                    f"row {i} has duplicate column ids; SparseBatch "
+                    "requires unique col_ids per row (pre-sum duplicates "
+                    "on the host)"
+                )
+            vals[i, : len(c)] = v
+            cols[i, : len(c)] = c
     weights = np.ones(n) if weights is None else np.asarray(weights)
     offsets = np.zeros(n) if offsets is None else np.asarray(offsets)
     lab = np.zeros(n_out)

@@ -180,11 +180,16 @@ class GameEstimator:
             dim = train.feature_dim(coord_cfg.feature_shard)
             rows = feats
             if cfg.intercept:
-                rows = [
-                    (np.append(c, dim).astype(np.int32),
-                     np.append(v, 1.0).astype(np.float32))
-                    for c, v in rows
-                ]
+                from photon_ml_tpu.data.sparse_rows import SparseRows
+
+                if isinstance(rows, SparseRows):
+                    rows = rows.with_constant_col(dim)
+                else:
+                    rows = [
+                        (np.append(c, dim).astype(np.int32),
+                         np.append(v, 1.0).astype(np.float32))
+                        for c, v in rows
+                    ]
                 intercept_index = dim
                 dim += 1
             if mesh is not None:
@@ -276,28 +281,72 @@ class GameEstimator:
 
     def _import_random(self, comp: RandomEffectModel, coord):
         """Map a saved RandomEffectModel onto a (possibly different)
-        training-run grouping by entity id; unseen entities start at 0."""
+        training-run grouping by entity id; unseen entities start at 0.
+
+        Fully vectorized (SURVEY §7 entity-ETL scale): one sorted join
+        of new vs saved entity ids, then per-(new bucket, old bucket)
+        block gathers — the bucket grid is O(log² max-count), each cell
+        one fancy-indexed copy."""
         w0s = [np.zeros((blk.shape[0], blk.shape[-1]), np.float32)
                for blk in coord.x_blocks]
         g = coord.grouping
-        for e in range(g.n_total_entities):
-            eid = g.entity_ids[e]
-            b, s = int(g.entity_bucket[e]), int(g.entity_slot[e])
-            if coord.projection is None:
-                w = (comp.coefficients_for(eid)
-                     if comp.projection is None
-                     else comp.global_coefficients_for(eid))
-                if w is not None and len(w) == w0s[b].shape[1]:
-                    w0s[b][s] = w
-            else:
-                w_g = comp.global_coefficients_for(eid)
-                if w_g is None:
+        gs = comp.grouping
+        if g.n_total_entities == 0 or gs.n_total_entities == 0:
+            return [jnp.asarray(w) for w in w0s]
+
+        # Sorted join on entity id (both sides are np.unique output =
+        # sorted; saved models preserve that order through I/O).
+        saved_pos = gs.join_ids(np.asarray(g.entity_ids))
+        found = saved_pos >= 0
+        pos_c = np.maximum(saved_pos, 0)
+        old_bucket = np.asarray(gs.entity_bucket)[pos_c]
+        old_slot = np.asarray(gs.entity_slot)[pos_c]
+        new_bucket = np.asarray(g.entity_bucket)
+        new_slot = np.asarray(g.entity_slot)
+
+        old_blocks = [np.asarray(blk) for blk in comp.coefficient_blocks]
+        for b in range(len(w0s)):
+            for ob in range(len(old_blocks)):
+                sel = found & (new_bucket == b) & (old_bucket == ob)
+                if not sel.any():
                     continue
-                fids = coord.projection.feature_ids[b][s]
-                valid = fids >= 0
-                loc = np.zeros(w0s[b].shape[1], np.float32)
-                loc[valid] = w_g[fids[valid]]
-                w0s[b][s] = loc
+                ns, os_ = new_slot[sel], old_slot[sel]
+                blk_old = old_blocks[ob][os_]           # [m, p_old]
+                if coord.projection is None and comp.projection is None:
+                    if blk_old.shape[1] != w0s[b].shape[1]:
+                        continue  # width mismatch: entity starts at 0
+                    w0s[b][ns] = blk_old
+                elif coord.projection is None:
+                    # Saved model projected, target dense: scatter each
+                    # entity's local coefs to its global columns.
+                    if comp.projection.global_dim != w0s[b].shape[1]:
+                        continue
+                    fids = comp.projection.feature_ids[ob][os_]
+                    rr, cc = np.nonzero(fids >= 0)
+                    w0s[b][ns[rr], fids[rr, cc]] = blk_old[rr, cc]
+                elif comp.projection is None:
+                    # Saved dense, target projected: gather the target's
+                    # subspace columns out of the saved global rows.
+                    fids = coord.projection.feature_ids[b][ns]  # [m, p]
+                    valid = fids >= 0
+                    valid &= fids < blk_old.shape[1]
+                    rr, cc = np.nonzero(valid)
+                    w0s[b][ns[rr], cc] = blk_old[rr, fids[rr, cc]]
+                else:
+                    # Both projected: sparse merge-join on (entity,
+                    # global col) keys.
+                    from photon_ml_tpu.game.dataset import sorted_key_join
+
+                    G = np.int64(comp.projection.global_dim)
+                    f_old = comp.projection.feature_ids[ob][os_]
+                    ro, co = np.nonzero(f_old >= 0)
+                    key_old = ro.astype(np.int64) * G + f_old[ro, co]
+                    f_new = coord.projection.feature_ids[b][ns]
+                    rn, cn = np.nonzero((f_new >= 0) & (f_new < G))
+                    key_new = rn.astype(np.int64) * G + f_new[rn, cn]
+                    w_at, hit = sorted_key_join(key_old, blk_old[ro, co],
+                                                key_new)
+                    w0s[b][ns[rn[hit]], cn[hit]] = w_at[hit]
         return [jnp.asarray(w) for w in w0s]
 
     def _warm_coefficients(self, coords: dict, prep: dict) -> dict:

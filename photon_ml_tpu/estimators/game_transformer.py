@@ -46,6 +46,10 @@ def _score_fixed(model: FixedEffectModel, dataset: GameDataset) -> np.ndarray:
         return np.asarray(jnp.asarray(x) @ jnp.asarray(w_np))
     # Sparse rows: gather-dot per example; intercept is the last coef.
     base = w_np[-1] if model.intercept else 0.0
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+
+    if isinstance(feats, SparseRows):
+        return feats.dot_dense(w_np.astype(np.float64)) + np.float32(base)
     return np.asarray(
         [float(v @ w_np[c]) + base for c, v in feats], np.float32
     )
@@ -53,34 +57,60 @@ def _score_fixed(model: FixedEffectModel, dataset: GameDataset) -> np.ndarray:
 
 def _score_random(model: RandomEffectModel, entity_ids: np.ndarray,
                   dataset: GameDataset) -> np.ndarray:
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+
     n = dataset.n
-    index = model.grouping.entity_index()
+    idx = model.grouping.join_ids(entity_ids)
 
     if model.projection is None:
         feats = dataset.features[model.feature_shard]
         x = np.asarray(feats, np.float32)
         w_all = np.asarray(model.all_coefficients())   # [E, d_re]
-        # The "join": id → trained row, unseen → extra zero row.
-        uniq = {int(e): i for i, e in enumerate(model.grouping.entity_ids)}
-        idx = np.asarray([uniq.get(int(e), -1) for e in entity_ids])
         w_pad = np.vstack([w_all, np.zeros((1, w_all.shape[1]), w_all.dtype)])
         gathered = w_pad[idx]                           # -1 → zero row
         return np.einsum("nd,nd->n", x, gathered).astype(np.float32)
 
-    # Projected model: score in each entity's local subspace.
+    # Projected model: score in each entity's local subspace via a
+    # sorted merge-join of (entity row, global col) keys — data side
+    # from the example features, model side from each entity's
+    # subspace — all vectorized (no per-example Python).
     feats = dataset.features[model.feature_shard]
-    scores = np.zeros(n, np.float32)
-    cache: dict = {}
-    for i in range(n):
-        e = int(entity_ids[i])
-        if e not in cache:
-            cache[e] = model.global_coefficients_for(e)
-        w_g = cache[e]
-        if w_g is None:
+    rows = SparseRows.from_rows(feats)
+    g = model.grouping
+    G = np.int64(model.projection.global_dim)
+
+    # Model side: (entity row, global col) → coefficient value.
+    keys_parts, vals_parts = [], []
+    ent_row_of = g.entity_row_map()
+    for b, blk in enumerate(model.coefficient_blocks):
+        fids = model.projection.feature_ids[b]
+        blk = np.asarray(blk)
+        rr, cc = np.nonzero(fids >= 0)
+        if not len(rr):
             continue
-        c, v = feats[i]
-        scores[i] = float(v @ w_g[c])
-    return scores
+        erow = ent_row_of[b, rr]
+        keys_parts.append(erow * G + fids[rr, cc])
+        vals_parts.append(blk[rr, cc].astype(np.float64))
+    if not keys_parts:
+        return np.zeros(n, np.float32)
+    key_m = np.concatenate(keys_parts)
+    val_m = np.concatenate(vals_parts)
+
+    # Data side: one key per stored entry whose example's entity
+    # trained AND whose column is inside the trained global space —
+    # out-of-space ids would alias into the next entity's key range.
+    from photon_ml_tpu.game.dataset import sorted_key_join
+
+    row_of = rows.row_of()
+    erow_nnz = idx[row_of]
+    dsel = (erow_nnz >= 0) & (rows.cols.astype(np.int64) < G)
+    key_d = erow_nnz[dsel] * G + rows.cols[dsel].astype(np.int64)
+    w_at, hit = sorted_key_join(key_m, val_m, key_d)
+    contrib = np.zeros(rows.nnz, np.float64)
+    contrib[dsel] = np.where(hit, w_at, 0.0) * rows.vals[dsel]
+    cs = np.zeros(rows.nnz + 1, np.float64)
+    np.cumsum(contrib, out=cs[1:])
+    return (cs[rows.indptr[1:]] - cs[rows.indptr[:-1]]).astype(np.float32)
 
 
 @dataclasses.dataclass

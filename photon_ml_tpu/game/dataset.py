@@ -56,6 +56,9 @@ class EntityGrouping:
     example_bucket: np.ndarray  # [n]
     example_row: np.ndarray     # [n] entity slot in bucket
     example_col: np.ndarray     # [n] position within entity block
+    # [n] global entity index (into entity_ids) per example; None on
+    # groupings reloaded from saved models (example maps aren't stored).
+    example_entity: np.ndarray | None = None
 
     @property
     def n_total_entities(self) -> int:
@@ -68,6 +71,53 @@ class EntityGrouping:
             for e, b, s in zip(self.entity_ids, self.entity_bucket,
                                self.entity_slot)
         }
+
+    def join_ids(self, query_ids: np.ndarray) -> np.ndarray:
+        """id → global entity index (into ``entity_ids``), −1 for
+        unseen — the reference's RDD join as one vectorized
+        searchsorted (``entity_ids`` is np.unique output = sorted)."""
+        return sorted_id_join(np.asarray(self.entity_ids), query_ids)
+
+    def entity_row_map(self) -> np.ndarray:
+        """Dense (bucket, slot) → global entity index map
+        [n_buckets, max_entities_per_bucket], −1 for empty slots."""
+        n_buckets = len(self.capacities)
+        max_ne = max(self.n_entities) if self.n_entities else 1
+        out = np.full((n_buckets, max(max_ne, 1)), -1, np.int64)
+        out[self.entity_bucket, self.entity_slot] = np.arange(
+            self.n_total_entities)
+        return out
+
+
+def sorted_key_join(
+    keys: np.ndarray, vals: np.ndarray, query_keys: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Value of each query key under the (unique-keyed) ``keys → vals``
+    map: returns ``(values, hit)`` where ``hit[i]`` is False (and the
+    value meaningless) for absent keys.  ``keys`` need not be pre-sorted.
+    The merge-join primitive behind projected-model scoring and
+    warm-start import (packed ``entity·G + col`` int64 keys)."""
+    nq = len(query_keys)
+    if len(keys) == 0:
+        return np.zeros(nq, vals.dtype if len(vals) else np.float64), \
+            np.zeros(nq, bool)
+    order = np.argsort(keys)
+    ks, vs = keys[order], vals[order]
+    p = np.minimum(np.searchsorted(ks, query_keys), len(ks) - 1)
+    return vs[p], ks[p] == query_keys
+
+
+def sorted_id_join(sorted_ids: np.ndarray,
+                   query_ids: np.ndarray) -> np.ndarray:
+    """Each query id's position in ``sorted_ids`` (ascending, unique),
+    −1 where absent.  Shared by scoring, warm-start import, and
+    projection — one implementation of the join idiom."""
+    if len(sorted_ids) == 0:
+        return np.full(len(query_ids), -1, np.int64)
+    ids = np.asarray(query_ids, sorted_ids.dtype)
+    pos = np.searchsorted(sorted_ids, ids)
+    pos_c = np.minimum(pos, len(sorted_ids) - 1)
+    return np.where(sorted_ids[pos_c] == ids, pos_c, -1)
 
 
 def group_by_entity(
@@ -98,31 +148,36 @@ def group_by_entity(
     cap_arr = np.asarray(cap_list)
     bucket_of_entity = np.searchsorted(cap_arr, caps_needed, side="left")
 
-    # Keep only non-empty buckets, re-indexed densely.
+    # Keep only non-empty buckets, re-indexed densely.  (Everything
+    # below is vectorized: E can be millions — see SURVEY §7 "entity-
+    # grouping ETL at KDD2012 scale".)
     used = np.unique(bucket_of_entity)
-    remap = {int(b): i for i, b in enumerate(used)}
-    bucket_of_entity = np.asarray([remap[int(b)] for b in bucket_of_entity])
+    bucket_of_entity = np.searchsorted(used, bucket_of_entity)
     capacities = [int(cap_arr[b]) for b in used]
 
-    # Slot of each entity within its bucket (stable order by entity id).
+    # Slot of each entity within its bucket (stable order by entity id):
+    # sort entities by bucket; slot = rank within the bucket's run.
     n_buckets = len(used)
-    slot_of_entity = np.zeros(E, np.int64)
-    n_entities = []
-    for b in range(n_buckets):
-        members = np.where(bucket_of_entity == b)[0]
-        slot_of_entity[members] = np.arange(len(members))
-        n_entities.append(len(members))
+    order_e = np.argsort(bucket_of_entity, kind="stable")
+    sorted_b = bucket_of_entity[order_e]
+    bucket_starts = np.searchsorted(sorted_b, np.arange(n_buckets))
+    slot_of_entity = np.empty(E, np.int64)
+    slot_of_entity[order_e] = (
+        np.arange(E, dtype=np.int64) - bucket_starts[sorted_b]
+    )
+    n_entities = np.bincount(bucket_of_entity,
+                             minlength=n_buckets).tolist()
 
-    # Per-example coordinates: position within its entity via stable sort.
+    # Per-example coordinates: position within its entity via stable
+    # sort (stable ⇒ original example order within each entity, the
+    # reference's deterministic grouping).
     order = np.argsort(inverse, kind="stable")
+    entity_starts = np.zeros(E, np.int64)
+    np.cumsum(counts[:-1], out=entity_starts[1:])
     col = np.empty(n, np.int64)
-    # positions 0..count-1 within each entity, in original example order
-    # for determinism (stable sort preserves original order).
-    start = 0
-    for e in range(E):
-        c = counts[e]
-        col[order[start:start + c]] = np.arange(c)
-        start += c
+    col[order] = (
+        np.arange(n, dtype=np.int64) - entity_starts[inverse[order]]
+    )
 
     ex_entity = inverse
     return EntityGrouping(
@@ -136,6 +191,7 @@ def group_by_entity(
         example_bucket=bucket_of_entity[ex_entity],
         example_row=slot_of_entity[ex_entity],
         example_col=col,
+        example_entity=ex_entity,
     )
 
 
@@ -197,6 +253,10 @@ class GameDataset:
             return feats.shape[1]
         if shard in self.feature_dims:
             return int(self.feature_dims[shard])
+        from photon_ml_tpu.data.sparse_rows import SparseRows
+
+        if isinstance(feats, SparseRows):
+            return feats.max_col + 1
         return int(max((int(c.max()) for c, _ in feats if len(c)),
                        default=-1)) + 1
 
@@ -206,9 +266,13 @@ class GameDataset:
 
     def take(self, idx: np.ndarray) -> "GameDataset":
         """Row subset (train/validation splits in the drivers)."""
+        from photon_ml_tpu.data.sparse_rows import SparseRows
+
         def sub(feats):
             if isinstance(feats, np.ndarray):
                 return feats[idx]
+            if isinstance(feats, SparseRows):
+                return feats.take(idx)
             return [feats[int(i)] for i in idx]
 
         return GameDataset(

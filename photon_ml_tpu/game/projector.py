@@ -70,57 +70,69 @@ def build_subspace_projection(
       (projection, x_blocks) where ``x_blocks[b]`` is a dense
       [E_b, cap_b, p_b] array of projected features.
     """
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+
+    rows = SparseRows.from_rows(rows)
     n_buckets = len(grouping.capacities)
-    # Distinct features per entity.
-    entity_feats: list[np.ndarray] = []
-    for e in range(grouping.n_total_entities):
-        entity_feats.append(np.empty(0, np.int64))
-    feats_accum: dict[int, set] = {}
-    uniq_pos = {int(v): i for i, v in enumerate(grouping.entity_ids)}
+    E = grouping.n_total_entities
 
-    # Map each example to its global entity index via (bucket, row).
-    slot_to_entity = {}
-    for e in range(grouping.n_total_entities):
-        slot_to_entity[(int(grouping.entity_bucket[e]),
-                        int(grouping.entity_slot[e]))] = e
+    # Global entity index per example (stored by group_by_entity; rebuilt
+    # from (bucket, slot) for groupings that predate the field).
+    ex_entity = grouping.example_entity
+    if ex_entity is None:
+        ent_of = grouping.entity_row_map()
+        ex_entity = ent_of[grouping.example_bucket, grouping.example_row]
 
-    ex_entity = np.empty(grouping.n_examples, np.int64)
-    for i in range(grouping.n_examples):
-        ex_entity[i] = slot_to_entity[(int(grouping.example_bucket[i]),
-                                       int(grouping.example_row[i]))]
+    # Distinct (entity, global feature) pairs, sorted — each entity's
+    # subspace is its run of distinct features; the run offset is the
+    # feature's LOCAL column.  All vectorized (SURVEY §7 ETL scale).
+    row_of = rows.row_of()
+    ent_nnz = np.asarray(ex_entity)[row_of]
+    order = np.lexsort((rows.cols, ent_nnz))
+    e_s = ent_nnz[order]
+    c_s = rows.cols[order].astype(np.int64)
+    nnz = len(e_s)
+    if nnz:
+        new_g = np.empty(nnz, bool)
+        new_g[0] = True
+        np.logical_or(e_s[1:] != e_s[:-1], c_s[1:] != c_s[:-1],
+                      out=new_g[1:])
+        gid_s = np.cumsum(new_g) - 1
+        starts = np.flatnonzero(new_g)
+        ge = e_s[starts]                    # entity of each distinct feat
+        gc = c_s[starts]                    # global col of each
+    else:
+        gid_s = np.zeros(0, np.int64)
+        ge = np.zeros(0, np.int64)
+        gc = np.zeros(0, np.int64)
+    ent_feat_count = np.bincount(ge, minlength=E)
+    ent_feat_start = np.zeros(E, np.int64)
+    np.cumsum(ent_feat_count[:-1], out=ent_feat_start[1:])
+    loc_of_group = np.arange(len(ge), dtype=np.int64) - ent_feat_start[ge]
+    # Local column of every stored entry, in original nnz order.
+    loc = np.empty(nnz, np.int64)
+    loc[order] = loc_of_group[gid_s]
 
-    for i, (c, _) in enumerate(rows):
-        s = feats_accum.setdefault(int(ex_entity[i]), set())
-        s.update(int(x) for x in c)
-
-    for e, s in feats_accum.items():
-        entity_feats[e] = np.asarray(sorted(s), np.int64)
-
-    # Per-bucket local width = max distinct features among its entities.
     feature_ids = []
     x_blocks = []
+    ent_bucket = np.asarray(grouping.entity_bucket)
+    ent_slot = np.asarray(grouping.entity_slot)
     for b in range(n_buckets):
-        members = np.where(grouping.entity_bucket == b)[0]
-        p = max((len(entity_feats[e]) for e in members), default=1)
+        ne = grouping.n_entities[b]
+        members = ent_bucket == b
+        p = int(ent_feat_count[members].max()) if members.any() else 1
         p = max(p, 1)
-        fids = np.full((len(members), p), -1, np.int32)
-        local_index: list[dict] = []
-        for s_i, e in enumerate(members):
-            f = entity_feats[e]
-            fids[s_i, : len(f)] = f
-            local_index.append({int(g): j for j, g in enumerate(f)})
+        fids = np.full((ne, p), -1, np.int32)
+        gsel = ent_bucket[ge] == b
+        fids[ent_slot[ge[gsel]], loc_of_group[gsel]] = gc[gsel]
         feature_ids.append(fids)
 
         cap = grouping.capacities[b]
-        xb = np.zeros((len(members), cap, p), np.float32)
-        sel = np.where(grouping.example_bucket == b)[0]
-        for i in sel:
-            r = grouping.example_row[i]
-            col = grouping.example_col[i]
-            li = local_index[r]
-            c, v = rows[i]
-            for g, val in zip(c, v):
-                xb[r, col, li[int(g)]] = val
+        xb = np.zeros((ne, cap, p), np.float32)
+        nsel = ent_bucket[ent_nnz] == b
+        ex = row_of[nsel]
+        xb[grouping.example_row[ex], grouping.example_col[ex],
+           loc[nsel]] = rows.vals[nsel]
         x_blocks.append(xb)
 
     return SubspaceProjection(feature_ids=feature_ids,

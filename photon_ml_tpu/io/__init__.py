@@ -4,6 +4,17 @@ Reference: photon-api ``com.linkedin.photon.ml.io`` (SURVEY.md §2.4 —
 expected paths, mount unavailable).
 """
 
+from photon_ml_tpu.io.chunked import (
+    iter_jsonl_chunks,
+    iter_libsvm_chunks,
+    read_libsvm_chunked,
+)
 from photon_ml_tpu.io.libsvm import read_libsvm, write_libsvm
 
-__all__ = ["read_libsvm", "write_libsvm"]
+__all__ = [
+    "iter_jsonl_chunks",
+    "iter_libsvm_chunks",
+    "read_libsvm",
+    "read_libsvm_chunked",
+    "write_libsvm",
+]

@@ -135,9 +135,14 @@ def read_game_dataset(
       dense_shards: shards materialized as dense [n, d] float arrays
         (small per-entity shards); all others stay sparse row lists.
     """
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+
     entity_maps = entity_maps or {}
     labels, weights, offsets = [], [], []
-    shard_rows: dict = {s: [] for s in feature_maps}
+    # Flat per-shard accumulators (counts/cols/vals) — record parsing is
+    # inherently a Python loop, but per-example numpy arrays are not:
+    # the arrays are materialized ONCE per shard at the end.
+    shard_acc: dict = {s: ([], [], []) for s in feature_maps}
     id_cols: dict = {k: [] for k in entity_maps}
 
     for rec in _iter_records(path):
@@ -146,7 +151,8 @@ def read_game_dataset(
         offsets.append(float(rec.get("offset", 0.0)))
         feats = rec.get("features", {})
         for shard, imap in feature_maps.items():
-            idxs, vals = [], []
+            counts, idxs, vals = shard_acc[shard]
+            cnt = 0
             for name, term, value in _feature_entries(feats.get(shard, [])):
                 i = imap.get(feature_key(name, term))
                 if i < 0:
@@ -158,15 +164,8 @@ def read_game_dataset(
                     )
                 idxs.append(i)
                 vals.append(value)
-            c = np.asarray(idxs, np.int32)
-            v = np.asarray(vals, np.float32)
-            if len(c) and len(np.unique(c)) != len(c):
-                c, inv = np.unique(c, return_inverse=True)
-                v = np.bincount(inv, weights=v).astype(np.float32)
-            else:
-                order = np.argsort(c)
-                c, v = c[order], v[order]
-            shard_rows[shard].append((c, v))
+                cnt += 1
+            counts.append(cnt)
         ids = rec.get("ids", {})
         for key, imap in entity_maps.items():
             eid = str(ids.get(key, ""))
@@ -178,15 +177,15 @@ def read_game_dataset(
 
     n = len(labels)
     features: dict = {}
-    for shard, rows in shard_rows.items():
+    for shard, (counts, idxs, vals) in shard_acc.items():
         dim = len(feature_maps[shard])
-        if shard in dense_shards:
-            x = np.zeros((n, dim), np.float32)
-            for r, (c, v) in enumerate(rows):
-                x[r, c] = v
-            features[shard] = x
-        else:
-            features[shard] = rows
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(np.asarray(counts, np.int64), out=indptr[1:])
+        rows = SparseRows.from_flat(
+            indptr, np.asarray(idxs, np.int64), np.asarray(vals, np.float64)
+        )
+        features[shard] = (rows.to_dense(dim) if shard in dense_shards
+                          else rows)
 
     w = np.asarray(weights, np.float32)
     o = np.asarray(offsets, np.float32)

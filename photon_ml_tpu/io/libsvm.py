@@ -7,15 +7,91 @@ SURVEY.md]) — the reference ingests Avro; its canonical small fixtures
 LIBSVM natively for parity fixtures and benchmarking; structured
 (Avro-equivalent) ingestion lives in ``photon_ml_tpu.io.dataset``.
 
-Output is host-side numpy (rows of (col_ids, values) + labels), which
+Output is host-side ``SparseRows`` (CSR arrays) + numpy labels, which
 ``make_sparse_batch`` / ``make_dense_batch`` turn into device-resident
 static-shape batches — the one host→HBM hop, after which training never
 touches the host again.
+
+``parse_libsvm_bytes`` is the single parse-and-canonicalize definition:
+``read_libsvm`` is the whole-file case, ``io.chunked`` feeds it byte
+windows — both therefore share one semantics (comment stripping, base
+conversion, out-of-space clipping, duplicate summing, per-row sort).
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+from photon_ml_tpu.data.sparse_rows import SparseRows
+
+
+def parse_libsvm_bytes(
+    data: bytes,
+    n_features: int | None = None,
+    zero_based: bool = False,
+    where: str = "<bytes>",
+) -> tuple[SparseRows, np.ndarray]:
+    """LIBSVM text bytes → (canonical SparseRows, raw float32 labels).
+
+    Uses the native C++ tokenizer when available; the Python tokenizer
+    is the fallback.  Either way canonicalization (sort within row, sum
+    duplicate ids, drop ``col >= n_features``) happens in ONE vectorized
+    ``SparseRows.from_flat`` pass.
+    """
+    from photon_ml_tpu.native import libsvm_parse_native, native_available
+
+    base = 0 if zero_based else 1
+    if native_available():
+        parsed = libsvm_parse_native(data)
+        if parsed is not None:
+            labels, row_ptr, cols, vals, _ = parsed
+            cols = cols.astype(np.int64) - base
+            if cols.size and cols.min() < 0:
+                raise ValueError(
+                    f"{where}: feature index below {base} "
+                    f"(zero_based={zero_based})"
+                )
+            rows = SparseRows.from_flat(row_ptr.astype(np.int64), cols,
+                                        vals, clip_dim=n_features)
+            return rows, np.asarray(labels, np.float32)
+
+    counts: list[int] = []
+    idxs: list[int] = []
+    vs: list[float] = []
+    labels_l: list[float] = []
+    for line in data.decode().splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split()
+        labels_l.append(float(parts[0]))
+        cnt = 0
+        for tok in parts[1:]:
+            i_str, v_str = tok.split(":")
+            i = int(i_str) - base
+            if i < 0:
+                raise ValueError(
+                    f"{where}: feature index below {base} "
+                    f"(zero_based={zero_based})"
+                )
+            idxs.append(i)
+            vs.append(float(v_str))
+            cnt += 1
+        counts.append(cnt)
+    indptr = np.zeros(len(counts) + 1, np.int64)
+    np.cumsum(np.asarray(counts, np.int64), out=indptr[1:])
+    rows = SparseRows.from_flat(indptr, np.asarray(idxs, np.int64),
+                                np.asarray(vs, np.float64),
+                                clip_dim=n_features)
+    return rows, np.asarray(labels_l, np.float32)
+
+
+def map_binary_labels(y: np.ndarray) -> np.ndarray:
+    """{-1,+1} labels → {0,1} when the label set is exactly that
+    (the reference's binary-classification convention)."""
+    if set(np.unique(y)) <= {-1.0, 1.0}:
+        return ((y + 1.0) / 2.0).astype(np.float32)
+    return y
 
 
 def read_libsvm(
@@ -23,129 +99,40 @@ def read_libsvm(
     n_features: int | None = None,
     zero_based: bool = False,
     binary_labels_to_01: bool = True,
-) -> tuple[list[tuple[np.ndarray, np.ndarray]], np.ndarray, int]:
+) -> tuple[SparseRows, np.ndarray, int]:
     """Parse a LIBSVM file → (rows, labels, dim).
 
     Args:
       path: file path. Lines: ``label idx:val idx:val ...`` (# comments ok).
       n_features: feature-space width; inferred as max index + 1 if None.
+        Features outside the declared space (e.g. test-set indices a
+        model never saw) are dropped, never allowed to dot into
+        out-of-range coefficients.
       zero_based: whether indices in the file start at 0 (LIBSVM default
         is 1-based, e.g. a1a).
-      binary_labels_to_01: map {-1,+1} labels to {0,1} (the reference's
-        binary-classification label convention).
+      binary_labels_to_01: map {-1,+1} labels to {0,1}.
 
     Returns:
-      rows: per-example (col_ids int32[], values float32[]) with column
-        ids deduplicated (duplicate indices summed, as SparseBatch
-        requires unique ids per row).
+      rows: ``SparseRows`` (CSR-backed; indexes/iterates as per-example
+        (col_ids int32[], values float32[]) pairs) with column ids
+        deduplicated (duplicate indices summed, as SparseBatch requires
+        unique ids per row).
       labels: float32 [n].
       dim: feature-space width.
     """
-    native = _read_libsvm_native(
-        path, n_features, zero_based, binary_labels_to_01
-    )
-    if native is not None:
-        return native
-
-    rows: list[tuple[np.ndarray, np.ndarray]] = []
-    labels: list[float] = []
-    max_idx = -1
-    with open(path) as f:
-        for line in f:
-            line = line.split("#", 1)[0].strip()
-            if not line:
-                continue
-            parts = line.split()
-            labels.append(float(parts[0]))
-            base = 0 if zero_based else 1
-            idxs, vals = [], []
-            for tok in parts[1:]:
-                i_str, v_str = tok.split(":")
-                i = int(i_str) - base
-                if i < 0:
-                    raise ValueError(
-                        f"{path}: feature index below {base} "
-                        f"(zero_based={zero_based})"
-                    )
-                idxs.append(i)
-                vals.append(float(v_str))
-            c = np.asarray(idxs, np.int32)
-            v = np.asarray(vals, np.float32)
-            if n_features is not None and len(c):
-                # Features outside the declared space (e.g. test-set
-                # indices a model never saw) are dropped, never allowed
-                # to dot into out-of-range coefficients.
-                keep = c < n_features
-                c, v = c[keep], v[keep]
-            if len(c):
-                max_idx = max(max_idx, int(c.max()))
-                if len(np.unique(c)) != len(c):
-                    # Sum duplicate indices so SparseBatch's unique-ids
-                    # invariant holds.
-                    c, inv = np.unique(c, return_inverse=True)
-                    v = np.bincount(inv, weights=v).astype(np.float32)
-            order = np.argsort(c)
-            rows.append((c[order], v[order]))
-
-    dim = n_features if n_features is not None else max_idx + 1
-    y = np.asarray(labels, np.float32)
-    if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:
-        y = (y + 1.0) / 2.0
-    return rows, y, dim
-
-
-def _read_libsvm_native(
-    path: str,
-    n_features: int | None,
-    zero_based: bool,
-    binary_labels_to_01: bool,
-):
-    """C++ tokenizer path (photon_ml_tpu.native); None → Python fallback.
-
-    Post-processing (base conversion, out-of-space clipping, duplicate
-    summing, per-row sort) stays here in vectorized numpy so both paths
-    share one semantics definition."""
-    from photon_ml_tpu.native import libsvm_parse_native, native_available
-
-    if not native_available():
-        return None
     with open(path, "rb") as f:
         data = f.read()
-    parsed = libsvm_parse_native(data)
-    if parsed is None:
-        return None
-    labels, row_ptr, cols, vals, _ = parsed
-    base = 0 if zero_based else 1
-    cols = cols.astype(np.int64) - base
-    if cols.size and cols.min() < 0:
-        raise ValueError(
-            f"{path}: feature index below {base} (zero_based={zero_based})"
-        )
-    max_idx = -1
-    rows: list[tuple[np.ndarray, np.ndarray]] = []
-    for i in range(len(labels)):
-        c = cols[row_ptr[i]:row_ptr[i + 1]].astype(np.int32)
-        v = vals[row_ptr[i]:row_ptr[i + 1]]
-        if n_features is not None and len(c):
-            keep = c < n_features
-            c, v = c[keep], v[keep]
-        if len(c):
-            max_idx = max(max_idx, int(c.max()))
-            if len(np.unique(c)) != len(c):
-                c, inv = np.unique(c, return_inverse=True)
-                v = np.bincount(inv, weights=v).astype(np.float32)
-        order = np.argsort(c)
-        rows.append((c[order], v[order]))
-    dim = n_features if n_features is not None else max_idx + 1
-    y = np.asarray(labels, np.float32)
-    if binary_labels_to_01 and set(np.unique(y)) <= {-1.0, 1.0}:
-        y = (y + 1.0) / 2.0
+    rows, y = parse_libsvm_bytes(data, n_features=n_features,
+                                 zero_based=zero_based, where=path)
+    dim = n_features if n_features is not None else rows.max_col + 1
+    if binary_labels_to_01:
+        y = map_binary_labels(y)
     return rows, y, dim
 
 
 def write_libsvm(
     path: str,
-    rows: list[tuple[np.ndarray, np.ndarray]],
+    rows,
     labels: np.ndarray,
     zero_based: bool = False,
 ) -> None:

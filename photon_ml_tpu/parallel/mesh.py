@@ -139,10 +139,17 @@ def shard_sparse_batch(
     from photon_ml_tpu.data.batch import make_sparse_batch
     from photon_ml_tpu.data.colmajor import build_colmajor, choose_capacity
 
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+
     n = len(labels)
     n_dev = mesh.devices.size
     per = padded_rows(n, n_dev) // n_dev
-    k = row_capacity or max((len(c) for c, _ in rows), default=1)
+    if row_capacity is not None:
+        k = row_capacity
+    elif isinstance(rows, SparseRows):
+        k = max(rows.max_nnz, 1)
+    else:
+        k = max((len(c) for c, _ in rows), default=1)
 
     weights = np.ones(n) if weights is None else np.asarray(weights)
     offsets = np.zeros(n) if offsets is None else np.asarray(offsets)
@@ -164,11 +171,14 @@ def shard_sparse_batch(
 
     if col_major:
         if col_capacity is None:
-            counts = np.bincount(
-                np.concatenate([np.asarray(c) for c, _ in rows])
-                if rows else np.zeros(0, np.int64),
-                minlength=dim,
-            )
+            if isinstance(rows, SparseRows):
+                all_cols = rows.cols
+            else:
+                all_cols = (
+                    np.concatenate([np.asarray(c) for c, _ in rows])
+                    if len(rows) else np.zeros(0, np.int64)
+                )
+            counts = np.bincount(all_cols, minlength=dim)
             col_capacity = choose_capacity(counts)
         # Per-shard virtual-row counts (cheap bincounts) → common padded
         # shape, so build_colmajor emits equal-shape shards directly.

@@ -30,6 +30,8 @@ from photon_ml_tpu.io.avro_schemas import (
     write_model_avro,
 )
 
+pytestmark = pytest.mark.fast
+
 
 # ---------------------------------------------------------------------------
 # Spec fixtures

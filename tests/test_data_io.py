@@ -2,6 +2,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from photon_ml_tpu.data.batch import make_dense_batch, make_sparse_batch
 from photon_ml_tpu.data.normalization import (
@@ -10,6 +11,8 @@ from photon_ml_tpu.data.normalization import (
 )
 from photon_ml_tpu.data.statistics import compute_statistics
 from photon_ml_tpu.io import read_libsvm, write_libsvm
+
+pytestmark = pytest.mark.fast
 
 
 def _random_sparse_rows(rng, n, d, nnz):
@@ -92,3 +95,42 @@ def test_stats_feed_normalization(rng):
     )
     np.testing.assert_allclose(norm.factors, 1.0 / x.std(0), rtol=1e-4)
     np.testing.assert_allclose(norm.shifts, x.mean(0), rtol=1e-5)
+
+
+def test_libsvm_chunked_matches_whole_file(rng, tmp_path):
+    from photon_ml_tpu.io import read_libsvm_chunked
+
+    n, d = 120, 40
+    rows = _random_sparse_rows(rng, n, d, 6)
+    labels = rng.choice([-1.0, 1.0], size=n)
+    path = str(tmp_path / "data.libsvm")
+    write_libsvm(path, rows, labels)
+    whole, y_w, dim_w = read_libsvm(path, n_features=d)
+    # Tiny windows force many chunk boundaries mid-file.
+    chunked, y_c, dim_c = read_libsvm_chunked(path, n_features=d,
+                                              chunk_bytes=256)
+    assert dim_c == dim_w
+    np.testing.assert_array_equal(y_c, y_w)
+    assert len(chunked) == len(whole)
+    for (c1, v1), (c2, v2) in zip(whole, chunked):
+        np.testing.assert_array_equal(c1, c2)
+        np.testing.assert_allclose(v1, v2, rtol=1e-6)
+
+
+def test_jsonl_chunks_round_trip(tmp_path):
+    import json
+
+    from photon_ml_tpu.io import iter_jsonl_chunks
+
+    path = str(tmp_path / "r.jsonl")
+    recs = [{"label": i, "features": {}} for i in range(25)]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    got = []
+    sizes = []
+    for batch in iter_jsonl_chunks(path, chunk_records=10):
+        sizes.append(len(batch))
+        got.extend(batch)
+    assert sizes == [10, 10, 5]
+    assert got == recs

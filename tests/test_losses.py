@@ -11,6 +11,8 @@ import pytest
 
 from photon_ml_tpu.ops import losses
 
+pytestmark = pytest.mark.fast
+
 
 ALL = [losses.LOGISTIC, losses.SQUARED, losses.POISSON, losses.SMOOTHED_HINGE]
 LABELS = {

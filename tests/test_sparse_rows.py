@@ -1,0 +1,365 @@
+"""SparseRows (CSR scale-ETL container) + vectorized grouping/projection.
+
+Strategy: every vectorized path is pinned against a brute-force
+per-row/per-entity reference on random data — the same parity discipline
+the optimizer tests use against scipy/sklearn.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.sparse_rows import SparseRows
+from photon_ml_tpu.game.dataset import group_by_entity
+
+pytestmark = pytest.mark.fast
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def random_raw(rng, n=200, dim=50, max_nnz=8, dupes=True):
+    """Raw (indptr, cols, vals) with unsorted cols and duplicates."""
+    counts = rng.integers(0, max_nnz, n)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    nnz = int(indptr[-1])
+    cols = rng.integers(0, dim, nnz)
+    if not dupes:
+        # resample rows to unique ids
+        parts = []
+        for i in range(n):
+            c = rng.choice(dim, size=min(int(counts[i]), dim), replace=False)
+            parts.append(c)
+        counts = np.asarray([len(p) for p in parts])
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        cols = (np.concatenate(parts) if parts else np.zeros(0, np.int64))
+        nnz = int(indptr[-1])
+    vals = rng.normal(size=nnz)
+    return indptr, cols, vals
+
+
+def brute_canonical(indptr, cols, vals, clip_dim=None):
+    rows = []
+    for i in range(len(indptr) - 1):
+        c = cols[indptr[i]:indptr[i + 1]]
+        v = vals[indptr[i]:indptr[i + 1]]
+        if clip_dim is not None:
+            keep = c < clip_dim
+            c, v = c[keep], v[keep]
+        if len(c):
+            cu, inv = np.unique(c, return_inverse=True)
+            vu = np.bincount(inv, weights=v)
+        else:
+            cu, vu = c, v
+        rows.append((cu.astype(np.int32), vu.astype(np.float32)))
+    return rows
+
+
+class TestFromFlat:
+    def test_canonicalizes(self, rng):
+        indptr, cols, vals = random_raw(rng)
+        sr = SparseRows.from_flat(indptr, cols, vals)
+        ref = brute_canonical(indptr, cols, vals)
+        assert len(sr) == len(ref)
+        for i, (c, v) in enumerate(ref):
+            sc, sv = sr[i]
+            np.testing.assert_array_equal(sc, c)
+            np.testing.assert_allclose(sv, v, rtol=1e-6)
+
+    def test_clip_dim(self, rng):
+        indptr, cols, vals = random_raw(rng, dim=50)
+        sr = SparseRows.from_flat(indptr, cols, vals, clip_dim=20)
+        ref = brute_canonical(indptr, cols, vals, clip_dim=20)
+        assert sr.max_col < 20
+        for i, (c, v) in enumerate(ref):
+            sc, sv = sr[i]
+            np.testing.assert_array_equal(sc, c)
+            np.testing.assert_allclose(sv, v, rtol=1e-6)
+
+    def test_negative_col_raises(self):
+        with pytest.raises(ValueError, match="negative"):
+            SparseRows.from_flat(np.array([0, 1]), np.array([-1]),
+                                 np.array([1.0]))
+
+    def test_empty(self):
+        sr = SparseRows.from_flat(np.zeros(1, np.int64),
+                                  np.zeros(0), np.zeros(0))
+        assert len(sr) == 0 and sr.nnz == 0 and sr.max_col == -1
+
+
+class TestRowListProtocol:
+    def test_round_trip_from_rows(self, rng):
+        indptr, cols, vals = random_raw(rng, dupes=False)
+        ref = brute_canonical(indptr, cols, vals)
+        sr = SparseRows.from_rows(ref)
+        for (c, v), (sc, sv) in zip(ref, sr):
+            np.testing.assert_array_equal(sc, c)
+            np.testing.assert_allclose(sv, v, rtol=1e-6)
+
+    def test_slice_matches_take(self, rng):
+        indptr, cols, vals = random_raw(rng)
+        sr = SparseRows.from_flat(indptr, cols, vals)
+        sl = sr[10:50]
+        tk = sr.take(np.arange(10, 50))
+        np.testing.assert_array_equal(sl.indptr, tk.indptr)
+        np.testing.assert_array_equal(sl.cols, tk.cols)
+        np.testing.assert_array_equal(sl.vals, tk.vals)
+
+    def test_take_reorders(self, rng):
+        indptr, cols, vals = random_raw(rng)
+        sr = SparseRows.from_flat(indptr, cols, vals)
+        idx = rng.permutation(len(sr))[:60]
+        sub = sr.take(idx)
+        for j, i in enumerate(idx):
+            sc, sv = sr[int(i)]
+            tc, tv = sub[j]
+            np.testing.assert_array_equal(tc, sc)
+            np.testing.assert_array_equal(tv, sv)
+
+
+class TestTransforms:
+    def test_with_constant_col(self, rng):
+        indptr, cols, vals = random_raw(rng, dim=30)
+        sr = SparseRows.from_flat(indptr, cols, vals)
+        out = sr.with_constant_col(30, 1.0)
+        assert len(out) == len(sr)
+        for i in range(len(sr)):
+            c0, v0 = sr[i]
+            c1, v1 = out[i]
+            np.testing.assert_array_equal(c1, np.append(c0, 30))
+            np.testing.assert_allclose(v1, np.append(v0, 1.0))
+
+    def test_with_constant_col_rejects_low_id(self, rng):
+        indptr, cols, vals = random_raw(rng, dim=30)
+        sr = SparseRows.from_flat(indptr, cols, vals)
+        with pytest.raises(ValueError, match="intercept"):
+            sr.with_constant_col(int(sr.max_col))
+
+    def test_to_ell_matches_legacy(self, rng):
+        from photon_ml_tpu.data.batch import make_sparse_batch
+
+        indptr, cols, vals = random_raw(rng, dupes=False)
+        ref_rows = brute_canonical(indptr, cols, vals)
+        sr = SparseRows.from_rows(ref_rows)
+        labels = rng.normal(size=len(sr)).astype(np.float32)
+        b_list = make_sparse_batch(ref_rows, 50, labels, pad_to=256)
+        b_sr = make_sparse_batch(sr, 50, labels, pad_to=256)
+        np.testing.assert_array_equal(np.asarray(b_list.col_ids),
+                                      np.asarray(b_sr.col_ids))
+        np.testing.assert_array_equal(np.asarray(b_list.values),
+                                      np.asarray(b_sr.values))
+
+    def test_to_ell_capacity_error(self, rng):
+        sr = SparseRows.from_rows([(np.arange(5), np.ones(5))])
+        with pytest.raises(ValueError, match="capacity"):
+            sr.to_ell(row_capacity=3)
+
+    def test_concat(self, rng):
+        parts = []
+        for s in range(3):
+            indptr, cols, vals = random_raw(np.random.default_rng(s), n=40)
+            parts.append(SparseRows.from_flat(indptr, cols, vals))
+        cat = SparseRows.concat(parts)
+        assert len(cat) == 120
+        i = 0
+        for p in parts:
+            for c, v in p:
+                cc, cv = cat[i]
+                np.testing.assert_array_equal(cc, c)
+                np.testing.assert_array_equal(cv, v)
+                i += 1
+
+    def test_dot_dense(self, rng):
+        indptr, cols, vals = random_raw(rng, dim=30)
+        sr = SparseRows.from_flat(indptr, cols, vals)
+        w = rng.normal(size=30)
+        ref = np.asarray([float(v @ w[c]) for c, v in sr], np.float32)
+        np.testing.assert_allclose(sr.dot_dense(w), ref, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_to_dense(self, rng):
+        indptr, cols, vals = random_raw(rng, dim=30, dupes=False)
+        sr = SparseRows.from_flat(indptr, cols, vals)
+        x = sr.to_dense(30)
+        for i, (c, v) in enumerate(sr):
+            ref = np.zeros(30, np.float32)
+            ref[c] = v
+            np.testing.assert_allclose(x[i], ref)
+
+
+class TestVectorizedGrouping:
+    """group_by_entity's vectorized form vs first-principles invariants."""
+
+    def test_slots_dense_and_cols_stable(self, rng):
+        ids = rng.integers(0, 97, 3000)
+        g = group_by_entity(ids)
+        # Every (bucket, slot) pair dense and unique.
+        for b in range(len(g.capacities)):
+            slots = np.sort(g.entity_slot[g.entity_bucket == b])
+            np.testing.assert_array_equal(slots, np.arange(len(slots)))
+        # Within an entity, cols are 0..count-1 in original example order.
+        for e in rng.choice(g.n_total_entities, 10, replace=False):
+            sel = np.flatnonzero(ids == g.entity_ids[e])
+            np.testing.assert_array_equal(
+                g.example_col[sel], np.arange(len(sel)))
+        # example_entity maps back to the right ids.
+        np.testing.assert_array_equal(g.entity_ids[g.example_entity], ids)
+
+    def test_capacity_bound(self, rng):
+        ids = np.repeat(np.arange(30), rng.integers(1, 300, 30))
+        g = group_by_entity(ids, bucket_base=4)
+        counts = np.bincount(ids)
+        for e in range(g.n_total_entities):
+            cap = g.capacities[g.entity_bucket[e]]
+            assert counts[e] <= cap < max(4 * counts[e], 5)
+
+
+class TestVectorizedProjection:
+    def test_matches_bruteforce(self, rng):
+        from photon_ml_tpu.game.projector import build_subspace_projection
+
+        n, G = 400, 60
+        ids = rng.integers(0, 37, n)
+        indptr, cols, vals = random_raw(rng, n=n, dim=G)
+        sr = SparseRows.from_flat(indptr, cols, vals)
+        g = group_by_entity(ids)
+        proj, x_blocks = build_subspace_projection(g, sr, G)
+        # Brute-force: each entity's subspace is its sorted distinct
+        # cols; each example's block row holds its values at local idx.
+        for e in rng.choice(g.n_total_entities, 12, replace=False):
+            sel = np.flatnonzero(ids == g.entity_ids[e])
+            feats = np.unique(np.concatenate(
+                [sr[int(i)][0] for i in sel]
+                or [np.zeros(0, np.int32)]))
+            b, s = int(g.entity_bucket[e]), int(g.entity_slot[e])
+            fids = proj.feature_ids[b][s]
+            np.testing.assert_array_equal(fids[fids >= 0], feats)
+            for i in sel:
+                c, v = sr[int(i)]
+                row = x_blocks[b][s, int(g.example_col[i])]
+                ref = np.zeros(len(fids), np.float32)
+                ref[np.searchsorted(feats, c)] = v
+                np.testing.assert_allclose(row, ref)
+
+    def test_projection_without_example_entity(self, rng):
+        # Groupings reloaded from saved models lack example maps only;
+        # in-ETL groupings may predate the example_entity field.
+        from photon_ml_tpu.game.projector import build_subspace_projection
+
+        n, G = 100, 20
+        ids = rng.integers(0, 11, n)
+        indptr, cols, vals = random_raw(rng, n=n, dim=G)
+        sr = SparseRows.from_flat(indptr, cols, vals)
+        g = group_by_entity(ids)
+        ref_proj, ref_blocks = build_subspace_projection(g, sr, G)
+        g.example_entity = None
+        proj, blocks = build_subspace_projection(g, sr, G)
+        for a, b in zip(ref_proj.feature_ids, proj.feature_ids):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(ref_blocks, blocks):
+            np.testing.assert_allclose(a, b)
+
+
+class TestReviewRegressions:
+    """Round-3 review findings, pinned."""
+
+    def test_negative_indexing(self, rng):
+        indptr, cols, vals = random_raw(rng, n=5)
+        sr = SparseRows.from_flat(indptr, cols, vals)
+        c_last, v_last = sr[-1]
+        c_ref, v_ref = sr[4]
+        np.testing.assert_array_equal(c_last, c_ref)
+        np.testing.assert_array_equal(v_last, v_ref)
+        with pytest.raises(IndexError):
+            sr[5]
+        with pytest.raises(IndexError):
+            sr[-6]
+
+    def test_join_ids_empty_grouping(self):
+        from photon_ml_tpu.game.dataset import sorted_id_join
+
+        out = sorted_id_join(np.zeros(0, np.int64), np.array([1, 2]))
+        np.testing.assert_array_equal(out, [-1, -1])
+
+    def test_projected_scoring_out_of_space_feature_scores_zero(self):
+        # A feature id >= global_dim must not alias into the next
+        # entity's key range (review finding: key = entity*G + col).
+        from photon_ml_tpu.estimators.game_transformer import _score_random
+        from photon_ml_tpu.game.dataset import GameDataset
+        from photon_ml_tpu.game.projector import SubspaceProjection
+        from photon_ml_tpu.models.game import RandomEffectModel
+
+        G = 3
+        ids = np.array([10, 11, 10, 11])
+        g = group_by_entity(ids)
+        proj = SubspaceProjection(
+            feature_ids=[np.array([[0, -1], [0, 1]], np.int32)],
+            global_dim=G,
+        )
+        blocks = [np.array([[5.0, 0.0], [7.0, 2.0]], np.float32)]
+        model = RandomEffectModel(
+            coefficient_blocks=blocks, grouping=g, feature_shard="re",
+            projection=proj,
+        )
+        # Example 0 (entity 10): feature col 3 == G aliases to
+        # (entity 11, col 0) under the flat key without the bound.
+        feats = SparseRows.from_rows([
+            (np.array([3]), np.array([1.0], np.float32)),
+            (np.array([0]), np.array([1.0], np.float32)),
+            (np.array([0]), np.array([2.0], np.float32)),
+            (np.array([1]), np.array([1.0], np.float32)),
+        ])
+        ds = GameDataset(
+            labels=np.zeros(4, np.float32), features={"re": feats},
+            entity_ids={"e": ids},
+        )
+        scores = _score_random(model, ids, ds)
+        ent10 = int(g.join_ids(np.array([10]))[0])
+        w10 = blocks[0][int(g.entity_slot[ent10])] \
+            if int(g.entity_bucket[ent10]) == 0 else None
+        np.testing.assert_allclose(
+            scores, [0.0, 7.0, 2 * 5.0, 2.0] if w10[0] == 5.0
+            else [0.0, 5.0, 2 * 7.0, 2.0])
+
+    def test_concat_with_empty_parts(self, rng):
+        indptr, cols, vals = random_raw(rng, n=10)
+        full = SparseRows.from_flat(indptr, cols, vals)
+        empty = SparseRows.from_flat(np.zeros(1, np.int64),
+                                     np.zeros(0), np.zeros(0))
+        cat = SparseRows.concat([empty, full, empty, full])
+        assert len(cat) == 20
+        for i in range(10):
+            a, b = cat[i], full[i]
+            np.testing.assert_array_equal(a[0], b[0])
+            a2, b2 = cat[10 + i], full[i]
+            np.testing.assert_array_equal(a2[0], b2[0])
+
+    def test_chunked_reader_comment_only_window(self, tmp_path):
+        from photon_ml_tpu.io import read_libsvm_chunked
+
+        path = str(tmp_path / "c.libsvm")
+        with open(path, "w") as f:
+            f.write("1 1:2.0\n")
+            for _ in range(5):
+                f.write("# filler comment line\n")
+            f.write("1 2:3.0\n")
+        rows, y, dim = read_libsvm_chunked(path, chunk_bytes=40)
+        assert len(rows) == 2 and dim == 2
+        np.testing.assert_array_equal(rows[0][0], [0])
+        np.testing.assert_array_equal(rows[1][0], [1])
+
+    def test_sorted_key_join(self, rng):
+        from photon_ml_tpu.game.dataset import sorted_key_join
+
+        keys = rng.choice(1000, 50, replace=False)
+        vals = rng.normal(size=50)
+        q = np.concatenate([keys[:20], np.array([2000, 3000])])
+        got, hit = sorted_key_join(keys, vals, q)
+        np.testing.assert_array_equal(hit, [True] * 20 + [False] * 2)
+        np.testing.assert_allclose(got[:20], vals[:20])
+        got_e, hit_e = sorted_key_join(np.zeros(0, np.int64),
+                                       np.zeros(0), q)
+        assert not hit_e.any()
