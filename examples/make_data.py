@@ -1,0 +1,61 @@
+"""Generate the small example datasets the configs in this directory use.
+
+Usage::
+
+    python examples/make_data.py          # writes examples/data/*
+
+Produces:
+  - ``a1a_like.libsvm`` / ``a1a_like.t.libsvm`` — binary-classification
+    LIBSVM fixtures shaped like the reference's a1a (Adult) examples
+    (photon-ml ``examples`` [expected path, mount unavailable — see
+    SURVEY.md §2.8]).
+  - ``game_train.jsonl`` / ``game_valid.jsonl`` — movielens-shaped GAME
+    records (global features + per-user random effect), the reference's
+    GAME training-tutorial shape.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from photon_ml_tpu.io.dataset import write_game_dataset  # noqa: E402
+from photon_ml_tpu.io.libsvm import write_libsvm  # noqa: E402
+from photon_ml_tpu.utils.synthetic import (  # noqa: E402
+    make_a1a_like,
+    make_movielens_like,
+)
+
+
+def main(out_dir=None):
+    out = out_dir or os.path.join(os.path.dirname(__file__), "data")
+    os.makedirs(out, exist_ok=True)
+
+    rows, labels, _ = make_a1a_like(n=2000, seed=5)
+    write_libsvm(os.path.join(out, "a1a_like.libsvm"),
+                 rows[:1600], np.where(labels[:1600] > 0, 1, -1))
+    write_libsvm(os.path.join(out, "a1a_like.t.libsvm"),
+                 rows[1600:], np.where(labels[1600:] > 0, 1, -1))
+
+    data = make_movielens_like(n_users=40, n_items=12, n_obs=2400,
+                               dim_global=8, seed=9)
+    n_tr = 2000
+    for path, sl in (("game_train.jsonl", slice(0, n_tr)),
+                     ("game_valid.jsonl", slice(n_tr, None))):
+        write_game_dataset(
+            os.path.join(out, path),
+            labels=data["labels"][sl],
+            features={
+                "global": data["x"][sl].astype(np.float32),
+                "user_re": np.ones((len(data["labels"][sl]), 1),
+                                   np.float32),
+            },
+            ids={"userId": data["user_ids"][sl]},
+        )
+    print(f"wrote example data under {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -130,6 +130,25 @@ def _save_result(result: FitResult, estimator: GameEstimator,
 def run(config: TrainingConfig, log: RunLogger | None = None) -> dict:
     """Full training pipeline; returns the written summary dict."""
     config.validate()
+    if config.distributed_init:
+        # Multi-host scale-out (SURVEY §7 stage 9): join the JAX
+        # coordination service before first backend use.  Coordinator
+        # address/process count/index come from JAX_COORDINATOR_ADDRESS
+        # / JAX_NUM_PROCESSES / JAX_PROCESS_ID (mapped here — JAX only
+        # auto-detects managed clusters like TPU pods/SLURM).
+        # Idempotent guard so a caller-initialized process doesn't crash.
+        import jax
+
+        if not jax.distributed.is_initialized():
+            kw = {}
+            if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+                kw["coordinator_address"] = \
+                    os.environ["JAX_COORDINATOR_ADDRESS"]
+            if os.environ.get("JAX_NUM_PROCESSES"):
+                kw["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+            if os.environ.get("JAX_PROCESS_ID"):
+                kw["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+            jax.distributed.initialize(**kw)
     os.makedirs(config.output_dir, exist_ok=True)
     if log is None:
         log = RunLogger(os.path.join(config.output_dir, "run_log.jsonl"))
@@ -152,10 +171,11 @@ def _run(config: TrainingConfig, log: RunLogger) -> dict:
             raise ValueError(
                 "hyperparameter tuning needs validation data "
                 "(validation_path or validation_fraction)")
-        with log.timed("fit", mode="tuning", trials=config.tuning.n_trials):
+        with log.timed("fit", profile_dir=config.profile_dir,
+                       mode="tuning", trials=config.tuning.n_trials):
             results = estimator.fit_tuned(train, valid, run_logger=log)
     else:
-        with log.timed("fit"):
+        with log.timed("fit", profile_dir=config.profile_dir):
             results = estimator.fit(train, validation=valid, run_logger=log)
     best = estimator.best(results)
 

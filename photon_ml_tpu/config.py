@@ -161,6 +161,16 @@ class TrainingConfig:
     # random-effect bucket blocks are entity-sharded (strategy #2).
     # None = single device.
     n_devices: int | None = None
+    # When set, the driver's fit phase runs under jax.profiler.trace
+    # and a TensorBoard/XProf device trace is written here (SURVEY §5.1).
+    profile_dir: str | None = None
+    # Multi-host scale-out (SURVEY §5.8/§7 stage 9): when true, the
+    # training driver calls jax.distributed.initialize() before any
+    # backend use (coordinator/process env read from the standard JAX
+    # env vars or cluster auto-detection).  The mesh then spans every
+    # process's local devices, with XLA collectives riding ICI within a
+    # slice and DCN across slices.  Single-process runs leave it false.
+    distributed_init: bool = False
 
     def validate(self) -> None:
         names = [c.name for c in self.coordinates]

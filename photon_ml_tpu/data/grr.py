@@ -62,10 +62,27 @@ def _next_pow2(x: int) -> int:
 
 def _group_ranks(keys: np.ndarray) -> np.ndarray:
     """Rank of each entry within its key group (0-based; assignment of
-    ranks within a group is arbitrary — callers only need distinctness,
-    so the faster unstable sort is used)."""
+    ranks within a group is arbitrary — callers only need distinctness).
+
+    Build-time hot path at 10⁸ entries, so two scale fast paths:
+    already-sorted keys (the row direction's (seg, window) keys arrive
+    in ELL row-major order) rank in one O(n) run-length pass with no
+    sort at all; otherwise a STABLE argsort — numpy's stable kind is a
+    radix sort for integer dtypes, O(n·passes) not O(n log n) — over
+    int32-compressed keys when the range allows (halves the passes)."""
     n = keys.size
-    order = np.argsort(keys)
+    if n == 0:
+        return np.zeros(0, np.int64)
+    if bool((keys[1:] >= keys[:-1]).all()):
+        newgrp = np.r_[True, keys[1:] != keys[:-1]]
+        gstart = np.maximum.accumulate(
+            np.where(newgrp, np.arange(n), 0))
+        return np.arange(n) - gstart
+    sort_keys = keys
+    if keys.dtype.itemsize > 4 and 0 <= int(keys.min()) \
+            and int(keys.max()) < np.iinfo(np.int32).max:
+        sort_keys = keys.astype(np.int32)
+    order = np.argsort(sort_keys, kind="stable")
     sk = keys[order]
     newgrp = np.r_[True, sk[1:] != sk[:-1]]
     gstart = np.maximum.accumulate(np.where(newgrp, np.arange(n), 0))
@@ -176,7 +193,8 @@ def build_grr_direction(
     seg = np.asarray(seg, np.int64)
     val = np.asarray(val, np.float32)
     keep0 = val != 0
-    idx, seg, val = idx[keep0], seg[keep0], val[keep0]
+    if not bool(keep0.all()):  # skip three 10⁸-entry gathers when dense
+        idx, seg, val = idx[keep0], seg[keep0], val[keep0]
     if idx.size and (idx.min() < 0 or idx.max() >= table_len):
         raise ValueError("idx out of range")
     if seg.size and (seg.min() < 0 or seg.max() >= n_segments):
@@ -198,9 +216,11 @@ def build_grr_direction(
             if n_segments > 8192:
                 segs = np.random.default_rng(0).choice(
                     n_segments, 4096, replace=False)
-                segs.sort()
-                p = np.searchsorted(segs, seg).clip(max=segs.size - 1)
-                samp = group_key[segs[p] == seg]
+                # Membership via a boolean LUT — one O(nnz) gather,
+                # vs. a binary search per entry.
+                lut = np.zeros(n_segments, bool)
+                lut[segs] = True
+                samp = group_key[lut[seg]]
             else:
                 samp = group_key
             _, counts = np.unique(samp, return_counts=True)
@@ -237,12 +257,18 @@ def build_grr_direction(
     # Supertiles: one per non-empty block, plus a dummy per empty
     # segment-window (every ow needs ≥1 supertile so its output block
     # is written).
-    blocks = np.unique(bk[kept])
+    bkk = bk[kept]
+    if bkk.size and bool((bkk[1:] >= bkk[:-1]).all()):
+        # Row-direction keys arrive sorted: unique = run boundaries,
+        # no 10⁸-entry sort.
+        blocks = bkk[np.r_[True, bkk[1:] != bkk[:-1]]]
+    else:
+        blocks = np.unique(bkk)
     present_ow = np.unique(blocks // n_gw) if blocks.size else np.empty(0, np.int64)
     missing_ow = np.setdiff1d(np.arange(n_ow, dtype=np.int64), present_ow)
     blocks = np.sort(np.r_[blocks, missing_ow * n_gw])
     n_st = blocks.size
-    st_of = np.searchsorted(blocks, bk[kept])
+    st_of = np.searchsorted(blocks, bkk)
 
     _mark("blocks")
     gw_of_st = (blocks % n_gw).astype(np.int32)

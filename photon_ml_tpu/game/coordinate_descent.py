@@ -163,7 +163,11 @@ def run_coordinate_descent(
             coord = coordinates[name]
             t0 = time.perf_counter()
             offsets = total - scores[name]
-            w, diag = coord.train(offsets, coefs.get(name))
+            # The warm-start buffer is rebound to the result right
+            # below, so let XLA write the new coefficients into the old
+            # buffer (donation; SURVEY §5.2).
+            w, diag = coord.train(offsets, coefs.get(name),
+                                  donate_warm_start=True)
             new_scores = coord.score(w)
             total = total - scores[name] + new_scores
             scores[name] = new_scores

@@ -94,9 +94,27 @@ def _apply_training_view(batch, offsets: Array, train_idx, train_weights):
     return sub.replace(offsets=offsets[train_idx], weights=train_weights)
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
-def _fixed_train_local(optimizer, config, has_l1, objective, batch, offsets,
-                       train_idx, train_weights, w0):
+def _jit_solve(fn, donate_argnums):
+    """(plain, warm-start-donating) jit pair for a solve entry.
+
+    Donation (SURVEY §5.2 rebuild guidance): the warm-start
+    coefficients are the one solve input shaped like a solve output, so
+    XLA can write the new coefficients into the old buffer — for
+    random effects that is the full [E_b, cap, p]-adjacent coefficient
+    blocks, the dominant recurring allocation of a CD sweep.
+    Coordinate descent rebinds ``coefs[name]`` to the result
+    immediately after each call, so the donated buffer is dead there;
+    direct ``train()`` callers (tests, notebooks) may reuse their
+    arrays, so the plain variant stays the default — donation is
+    opt-in via ``donate_warm_start``.
+    """
+    return (jax.jit(fn, static_argnums=(0, 1, 2)),
+            jax.jit(fn, static_argnums=(0, 1, 2),
+                    donate_argnums=donate_argnums))
+
+
+def _fixed_train_local_impl(optimizer, config, has_l1, objective, batch,
+                            offsets, train_idx, train_weights, w0):
     problem = OptimizationProblem(
         objective=objective, optimizer=optimizer, config=config
     )
@@ -104,9 +122,12 @@ def _fixed_train_local(optimizer, config, has_l1, objective, batch, offsets,
     return problem.run(view, w0, has_l1=has_l1)
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
-def _fixed_train_distributed(optimizer, config, has_l1, dist_obj, batch,
-                             offsets, train_idx, train_weights, w0):
+_fixed_train_local, _fixed_train_local_donating = _jit_solve(
+    _fixed_train_local_impl, donate_argnums=(8,))  # w0
+
+
+def _fixed_train_distributed_impl(optimizer, config, has_l1, dist_obj, batch,
+                                  offsets, train_idx, train_weights, w0):
     from photon_ml_tpu.optim.base import OptimizerType
 
     view = _apply_training_view(batch, offsets, train_idx, train_weights)
@@ -124,6 +145,10 @@ def _fixed_train_distributed(optimizer, config, has_l1, dist_obj, batch,
     )
     l1 = problem._l1_vector(w0.shape[-1]) if has_l1 else None
     return lbfgs_solve(vg, w0, config, l1_weight=l1)
+
+
+_fixed_train_distributed, _fixed_train_distributed_donating = _jit_solve(
+    _fixed_train_distributed_impl, donate_argnums=(8,))  # w0
 
 
 @jax.jit
@@ -145,9 +170,8 @@ def _re_block_batch(blocks, b: int, offsets: Array) -> DenseBatch:
     )
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2))
-def _re_train(optimizer, config, has_l1, objective, blocks, offsets: Array,
-              w0s: list[Array]):
+def _re_train_impl(optimizer, config, has_l1, objective, blocks,
+                   offsets: Array, w0s: list[Array]):
     problem = OptimizationProblem(
         objective=objective, optimizer=optimizer, config=config
     )
@@ -156,6 +180,10 @@ def _re_train(optimizer, config, has_l1, objective, blocks, offsets: Array,
         jax.vmap(run)(_re_block_batch(blocks, b, offsets), w0s[b])
         for b in range(len(blocks[0]))
     ]
+
+
+_re_train, _re_train_donating = _jit_solve(
+    _re_train_impl, donate_argnums=(6,))  # w0s blocks
 
 
 @partial(jax.jit, static_argnums=0)
@@ -225,17 +253,22 @@ class FixedEffectCoordinate(Coordinate):
         return _apply_training_view(self.batch, offsets, self.train_idx,
                                     self.train_weights)
 
-    def train(self, offsets: Array, warm_start: Array | None = None):
+    def train(self, offsets: Array, warm_start: Array | None = None,
+              donate_warm_start: bool = False):
         w0 = self.initial_coefficients() if warm_start is None else warm_start
         has_l1 = self.problem.has_l1()
         if self.distributed is None:
-            res = _fixed_train_local(
+            fn = (_fixed_train_local_donating if donate_warm_start
+                  else _fixed_train_local)
+            res = fn(
                 self.problem.optimizer, self.problem.config, has_l1,
                 self.problem.objective, self.batch, offsets,
                 self.train_idx, self.train_weights, w0,
             )
         else:
-            res = _fixed_train_distributed(
+            fn = (_fixed_train_distributed_donating if donate_warm_start
+                  else _fixed_train_distributed)
+            res = fn(
                 self.problem.optimizer, self.problem.config, has_l1,
                 self.distributed, self.batch, offsets,
                 self.train_idx, self.train_weights, w0,
@@ -303,9 +336,11 @@ class RandomEffectCoordinate(Coordinate):
         return (self.x_blocks, self.label_blocks, self.weight_blocks,
                 self.mask_blocks, self.ex_idx, self.row_idx, self.col_idx)
 
-    def train(self, offsets: Array, warm_start=None):
+    def train(self, offsets: Array, warm_start=None,
+              donate_warm_start: bool = False):
         w0s = self.initial_coefficients() if warm_start is None else warm_start
-        results = _re_train(
+        fn = _re_train_donating if donate_warm_start else _re_train
+        results = fn(
             self.problem.optimizer, self.problem.config,
             self.problem.has_l1(), self.problem.objective,
             self._blocks(), offsets, w0s,

@@ -50,16 +50,29 @@ class RunLogger:
         logger.info("%s %s", kind, fields)
 
     @contextlib.contextmanager
-    def timed(self, phase: str, **fields):
-        """The reference's ``Timed { }``: log phase start/end + duration."""
+    def timed(self, phase: str, profile_dir: str | None = None, **fields):
+        """The reference's ``Timed { }``: log phase start/end + duration.
+
+        ``profile_dir``: when set, the phase also runs under
+        ``jax.profiler.trace`` — a TensorBoard/XProf device trace lands
+        there (SURVEY §5.1: tracing is a first-class aux subsystem).
+        """
         self.event("phase_start", phase=phase, **fields)
         start = time.monotonic()
+        prof = contextlib.nullcontext()
+        if profile_dir:
+            import jax
+
+            prof = jax.profiler.trace(profile_dir)
         try:
-            yield
+            with prof:
+                yield
         finally:
             self.event(
                 "phase_end", phase=phase,
-                duration_s=round(time.monotonic() - start, 6), **fields,
+                duration_s=round(time.monotonic() - start, 6),
+                **({"profile_dir": profile_dir} if profile_dir else {}),
+                **fields,
             )
 
     def close(self) -> None:

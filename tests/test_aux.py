@@ -1,0 +1,83 @@
+"""Aux-subsystem tests: buffer donation + profiler hooks (SURVEY §5.1/§5.2)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.batch import make_dense_batch
+from photon_ml_tpu.game.coordinates import (
+    _fixed_train_local,
+    _fixed_train_local_donating,
+)
+from photon_ml_tpu.models.glm import TaskType
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.optim import OptimizerConfig
+from photon_ml_tpu.optim.base import OptimizerType
+from photon_ml_tpu.utils.run_log import RunLogger
+
+pytestmark = pytest.mark.fast
+
+
+def _solve_args(rng, donate=False):
+    n, d = 64, 5
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    batch = make_dense_batch(x, y)
+    from photon_ml_tpu.data.normalization import NormalizationContext
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+
+    objective = GLMObjective(
+        loss=TaskType.LOGISTIC_REGRESSION.loss,
+        reg=RegularizationContext.none(),
+        norm=NormalizationContext.identity(),
+    )
+    cfg = OptimizerConfig(max_iters=5, track_states=False)
+    offsets = jnp.zeros(n)
+    w0 = jnp.zeros(d)
+    return (OptimizerType.LBFGS, cfg, False, objective, batch, offsets,
+            None, None, w0)
+
+
+def test_donating_solve_aliases_warm_start(rng):
+    """The donating jit marks the warm-start buffer as aliased into the
+    outputs; the plain variant must not (direct callers reuse arrays)."""
+    args = _solve_args(rng)
+    donating = _fixed_train_local_donating.lower(*args).as_text()
+    plain = _fixed_train_local.lower(*args).as_text()
+    assert "tf.aliasing_output" in donating
+    assert "tf.aliasing_output" not in plain
+
+
+def test_donating_solve_matches_plain(rng):
+    args = _solve_args(rng)
+    res_plain = _fixed_train_local(*args)
+    # Fresh w0 buffer for the donating call (its HBM may be reused).
+    args_d = args[:8] + (jnp.zeros_like(args[8]),)
+    res_don = _fixed_train_local_donating(*args_d)
+    np.testing.assert_allclose(np.asarray(res_plain.w),
+                               np.asarray(res_don.w), rtol=1e-6)
+
+
+def test_timed_profile_dir_writes_trace(tmp_path):
+    log = RunLogger(path=None)
+    prof_dir = str(tmp_path / "trace")
+    with log.timed("profiled_phase", profile_dir=prof_dir):
+        jnp.sum(jnp.arange(128.0)).block_until_ready()
+    found = []
+    for root, _, files in os.walk(prof_dir):
+        found.extend(os.path.join(root, f) for f in files)
+    assert found, "jax.profiler.trace wrote no files"
+
+
+def test_timed_without_profile_is_plain(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    log = RunLogger(path=path)
+    with log.timed("plain_phase"):
+        pass
+    log.close()
+    from photon_ml_tpu.utils.run_log import read_run_log
+
+    ends = [e for e in read_run_log(path) if e["event"] == "phase_end"]
+    assert ends and "profile_dir" not in ends[0]

@@ -384,3 +384,52 @@ def test_read_libsvm_drops_out_of_range_indices(tmp_path):
     rows, _, dim = read_libsvm(path, n_features=5)
     assert dim == 5
     np.testing.assert_array_equal(rows[0][0], [0, 4])
+
+
+def test_driver_distributed_init_single_process(tmp_path):
+    """Multi-host scaffolding (SURVEY §7 stage 9): distributed_init=true
+    joins the JAX coordination service before backend use.  With a
+    1-process coordinator config this must work end to end; real DCN
+    scale-out only changes the env vars."""
+    import subprocess
+    import sys
+
+    rows, labels, _ = make_a1a_like(n=300, seed=5)
+    train_path = str(tmp_path / "d.libsvm")
+    write_libsvm(train_path, rows, np.where(labels > 0, 1, -1))
+    config = {
+        "task_type": "LOGISTIC_REGRESSION",
+        "coordinates": [{
+            "name": "global", "kind": "FIXED_EFFECT",
+            "feature_shard": "features",
+            "optimizer": {"reg_weight": 1.0, "max_iters": 20},
+        }],
+        "update_sequence": ["global"],
+        "input_path": train_path,
+        "output_dir": str(tmp_path / "out"),
+        "distributed_init": True,
+    }
+    cfg_path = str(tmp_path / "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(config, f)
+    import socket
+
+    with socket.socket() as s:  # grab a currently-free port
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "JAX_COORDINATOR_ADDRESS": f"localhost:{port}",
+        "JAX_NUM_PROCESSES": "1",
+        "JAX_PROCESS_ID": "0",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli.game_training_driver",
+         "--config", cfg_path],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert os.path.isdir(tmp_path / "out" / "model")
