@@ -112,6 +112,24 @@ def lib() -> "ctypes.CDLL | None":
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ]
+        dll.pml_grr_plan.restype = ctypes.c_void_p
+        dll.pml_grr_plan.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32,
+        ]
+        dll.pml_grr_plan_sizes.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        dll.pml_grr_plan_fill.argtypes = [ctypes.c_void_p] + [
+            ctypes.c_void_p] * 9
+        dll.pml_grr_plan_free.argtypes = [ctypes.c_void_p]
         _lib = dll
         return dll
 
@@ -236,3 +254,77 @@ def colmajor_build_native(
                           _ptr(counts), v_pad, _ptr(tvals), _ptr(trows),
                           _ptr(vcol))
     return tvals, trows, vcol
+
+
+def grr_plan_native(
+    cols: np.ndarray,
+    vals: np.ndarray,
+    direction: int,
+    table_len: int,
+    n_segments: int,
+    cap: int | None = None,
+):
+    """One GRR direction's plan straight from the row-ELL arrays, or
+    None when the native library is unavailable (numpy path in
+    ``data.grr.build_grr_direction``).
+
+    ``direction`` 0: idx=column, seg=row (the margins X·w direction);
+    1: idx=row, seg=column (the gradient Xᵀr direction).  Entries with
+    value 0 are dropped (zero the hot-column entries before calling).
+    Returns a dict with the plan arrays (hi/vals/dst per supertile,
+    block maps, spill COO) and the chosen cap; route coloring is the
+    caller's next step (``grr_routes_native``).
+    """
+    dll = lib()
+    if dll is None:
+        return None
+    cols = np.ascontiguousarray(cols, np.int32)
+    vals = np.ascontiguousarray(vals, np.float32)
+    n, k = cols.shape
+    handle = dll.pml_grr_plan(
+        _ptr(cols), _ptr(vals), n, k, int(direction), int(table_len),
+        int(n_segments), int(cap or 0),
+    )
+    if not handle:
+        raise MemoryError("pml_grr_plan allocation failed")
+    try:
+        n_st = ctypes.c_int64()
+        n_spill = ctypes.c_int64()
+        cap_out = ctypes.c_int32()
+        n_gw = ctypes.c_int32()
+        n_ow = ctypes.c_int32()
+        error = ctypes.c_int32()
+        dll.pml_grr_plan_sizes(
+            handle, ctypes.byref(n_st), ctypes.byref(n_spill),
+            ctypes.byref(cap_out), ctypes.byref(n_gw), ctypes.byref(n_ow),
+            ctypes.byref(error),
+        )
+        if error.value == 1:
+            raise ValueError("idx or seg out of range in GRR plan build")
+        if error.value:
+            return None  # size overflow: numpy path decides
+        st = int(n_st.value)
+        m = int(n_spill.value)
+        hi = np.empty((st, 128, 128), np.int8)
+        v_out = np.empty((st, 128, 128), np.float32)
+        dst = np.empty((st, 128, 128), np.int32)
+        gw_of_st = np.empty(st, np.int32)
+        ow_of_st = np.empty(st, np.int32)
+        first_of_ow = np.empty(st, np.int32)
+        spill_idx = np.zeros(m, np.int32)
+        spill_seg = np.zeros(m, np.int32)
+        spill_val = np.zeros(m, np.float32)
+        dll.pml_grr_plan_fill(
+            handle, _ptr(hi), _ptr(v_out), _ptr(dst), _ptr(gw_of_st),
+            _ptr(ow_of_st), _ptr(first_of_ow), _ptr(spill_idx),
+            _ptr(spill_seg), _ptr(spill_val),
+        )
+    finally:
+        dll.pml_grr_plan_free(handle)
+    return {
+        "hi": hi, "vals": v_out, "dst": dst, "gw_of_st": gw_of_st,
+        "ow_of_st": ow_of_st, "first_of_ow": first_of_ow,
+        "spill_idx": spill_idx, "spill_seg": spill_seg,
+        "spill_val": spill_val, "cap": int(cap_out.value),
+        "n_gw": int(n_gw.value), "n_ow": int(n_ow.value),
+    }

@@ -377,3 +377,366 @@ int32_t pml_grr_routes(const int32_t* dst, const int8_t* hi, int64_t n_st,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// GRR plan construction (the layout half of the sparse engine)
+// ---------------------------------------------------------------------------
+//
+// Builds one direction's gather-route-reduce plan straight from the
+// row-ELL arrays: the same pipeline as photon_ml_tpu.data.grr
+// .build_grr_direction (group-capacity ranks, supertile blocking,
+// start/final slot placement, padding bijection, spill COO), but as a
+// handful of streaming passes over the entries with small cache-local
+// counter tables — no 10^8-element comparison sorts, no full-size
+// temporaries.  Rank assignment within a group follows entry scan
+// order; the Python path's sort-based ranks may differ, but rank choice
+// is explicitly arbitrary (both produce valid plans whose contractions
+// agree — tested in tests/test_grr.py).
+//
+// Protocol: pml_grr_plan(...) -> handle; pml_grr_plan_sizes(handle,..);
+// pml_grr_plan_fill(handle, ...); pml_grr_plan_free(handle).
+// Route coloring stays in pml_grr_routes (shared with the Python path).
+
+namespace {
+
+constexpr int64_t GRR_WIN = 16384;
+constexpr int32_t GRR_TILE = 128;
+constexpr int64_t GRR_SLOTS = GRR_WIN;  // 128*128 slots per supertile
+
+struct GrrPlan {
+  int32_t error = 0;  // 1 = idx/seg out of range, 2 = size overflow
+  int32_t cap = 0, n_gw = 0, n_ow = 0;
+  int64_t n_st = 0, n_spill = 0;  // n_spill already padded to 8
+  std::vector<int8_t> hi;
+  std::vector<float> vals;
+  std::vector<int32_t> dst;
+  std::vector<int32_t> gw_of_st, ow_of_st, first_of_ow;
+  std::vector<int32_t> spill_idx, spill_seg;
+  std::vector<float> spill_val;
+};
+
+inline int32_t grr_next_pow2(int64_t x) {
+  int32_t p = 1;
+  while (p < x) p <<= 1;
+  return p;
+}
+
+// Body behind an exception firewall: std::bad_alloc must not unwind
+// through the extern "C"/ctypes boundary (that would terminate the
+// process instead of letting the caller fall back to numpy).
+void grr_plan_body(GrrPlan* plan, const int32_t* cols, const float* vals,
+                   int64_t n, int64_t k, int32_t direction,
+                   int64_t table_len, int64_t n_segments, int32_t cap_in) {
+  // Same cap validation as the numpy path (data/grr.py): a non-power-
+  // of-two cap makes distinct (q, b) pairs collide on one final slot.
+  if (cap_in != 0 && cap_in != 1 && cap_in != 2 && cap_in != 4 &&
+      cap_in != 8 && cap_in != 16 && cap_in != 32 && cap_in != 64 &&
+      cap_in != 128) {
+    plan->error = 3;
+    return;
+  }
+  constexpr int64_t kMaxCounterBytes = int64_t{1} << 33;  // 8 GB
+  const int64_t n_gw = table_len > 0 ? (table_len + GRR_WIN - 1) / GRR_WIN : 1;
+  plan->n_gw = static_cast<int32_t>(n_gw);
+  const int64_t m_ell = n * k;
+
+  // Pass A: count nonzeros, validate ranges, check (seg, gw) sortedness.
+  int64_t m_nz = 0;
+  bool sorted = true;
+  int64_t prev_key = -1;
+  for (int64_t e = 0; e < m_ell; ++e) {
+    const float v = vals[e];
+    if (v == 0.0f) continue;
+    const int64_t r = e / k;
+    const int64_t c = cols[e];
+    const int64_t idx = direction ? r : c;
+    const int64_t seg = direction ? c : r;
+    if (idx < 0 || idx >= table_len || seg < 0 || seg >= n_segments) {
+      plan->error = 1;
+      return;
+    }
+    const int64_t key = seg * n_gw + idx / GRR_WIN;
+    if (key < prev_key) sorted = false;
+    prev_key = key;
+    ++m_nz;
+  }
+
+  // Capacity: 1.5x the exact mean nonempty (seg, window) occupancy
+  // (the Python path estimates this mean by sampling segments; exact
+  // is strictly better and free here).
+  int32_t cap = cap_in;
+  if (cap <= 0) {
+    int64_t n_groups = 0;
+    if (sorted) {
+      prev_key = -1;
+      for (int64_t e = 0; e < m_ell; ++e) {
+        if (vals[e] == 0.0f) continue;
+        const int64_t r = e / k;
+        const int64_t c = cols[e];
+        const int64_t key = (direction ? c : r) * n_gw +
+                            (direction ? r : c) / GRR_WIN;
+        if (key != prev_key) ++n_groups;
+        prev_key = key;
+      }
+    } else {
+      const int64_t n_keys = n_segments * n_gw;
+      if (n_keys > kMaxCounterBytes) {
+        plan->error = 2;
+        return;
+      }
+      std::vector<uint8_t> visited(static_cast<size_t>(n_keys), 0);
+      for (int64_t e = 0; e < m_ell; ++e) {
+        if (vals[e] == 0.0f) continue;
+        const int64_t r = e / k;
+        const int64_t c = cols[e];
+        const int64_t key = (direction ? c : r) * n_gw +
+                            (direction ? r : c) / GRR_WIN;
+        if (!visited[key]) { visited[key] = 1; ++n_groups; }
+      }
+    }
+    const double mean = n_groups ? double(m_nz) / double(n_groups) : 1.0;
+    cap = grr_next_pow2(static_cast<int64_t>(mean * 1.5 + 0.999999));
+    if (cap < 4) cap = 4;
+    if (cap > 64) cap = 64;
+  }
+  plan->cap = cap;
+  const int64_t segwin = GRR_WIN / cap;
+  const int32_t group = GRR_TILE / cap;
+  const int64_t n_ow = n_segments > 0 ? (n_segments + segwin - 1) / segwin : 1;
+  plan->n_ow = static_cast<int32_t>(n_ow);
+  const int64_t n_bk = n_ow * n_gw;
+  if (n_bk * GRR_TILE * 2 > kMaxCounterBytes) {  // r2cnt bytes
+    plan->error = 2;
+    return;
+  }
+
+  // Rank counters.  q: per (seg, window) among all entries (uint8,
+  // cap <= 64 < 255 so saturate at 255 = spilled anyway).  rank2: per
+  // (block, lane residue) among cap-kept entries.
+  std::vector<uint8_t> qcnt;
+  if (!sorted) {
+    if (n_segments * n_gw > kMaxCounterBytes) {
+      plan->error = 2;
+      return;
+    }
+    qcnt.assign(static_cast<size_t>(n_segments * n_gw), 0);
+  }
+  std::vector<uint16_t> r2cnt(static_cast<size_t>(n_bk) * GRR_TILE, 0);
+  std::vector<int64_t> cnt_bk(static_cast<size_t>(n_bk), 0);
+
+  // Pass B: count kept entries per block (q + rank2 logic, no fills).
+  {
+    int64_t run_key = -1, run_q = 0;
+    for (int64_t e = 0; e < m_ell; ++e) {
+      const float v = vals[e];
+      if (v == 0.0f) continue;
+      const int64_t r = e / k;
+      const int64_t c = cols[e];
+      const int64_t idx = direction ? r : c;
+      const int64_t seg = direction ? c : r;
+      const int64_t gw = idx / GRR_WIN;
+      int64_t q;
+      if (sorted) {
+        const int64_t key = seg * n_gw + gw;
+        if (key != run_key) { run_key = key; run_q = 0; }
+        q = run_q++;
+      } else {
+        uint8_t& qc = qcnt[seg * n_gw + gw];
+        q = qc;
+        if (qc < 255) ++qc;
+      }
+      if (q >= cap) continue;  // spill1
+      const int64_t bk = (seg / segwin) * n_gw + gw;
+      uint16_t& r2 = r2cnt[bk * GRR_TILE + (idx % GRR_TILE)];
+      if (r2 >= GRR_TILE) { ++r2; continue; }  // spill2 (sat. anyway)
+      ++r2;
+      ++cnt_bk[bk];
+    }
+  }
+
+  // Block list: non-empty blocks ascending + a dummy per empty ow.
+  std::vector<int32_t> st_of_bk(static_cast<size_t>(n_bk), -1);
+  {
+    std::vector<uint8_t> ow_present(static_cast<size_t>(n_ow), 0);
+    for (int64_t b = 0; b < n_bk; ++b)
+      if (cnt_bk[b] > 0) ow_present[b / n_gw] = 1;
+    int64_t n_st = 0;
+    for (int64_t ow = 0; ow < n_ow; ++ow) {
+      if (ow_present[ow]) {
+        for (int64_t g = 0; g < n_gw; ++g)
+          if (cnt_bk[ow * n_gw + g] > 0) ++n_st;
+      } else {
+        ++n_st;  // dummy at (ow, gw=0)
+      }
+    }
+    plan->n_st = n_st;
+    plan->hi.assign(static_cast<size_t>(n_st) * GRR_SLOTS, 0);
+    plan->vals.assign(static_cast<size_t>(n_st) * GRR_SLOTS, 0.0f);
+    plan->dst.assign(static_cast<size_t>(n_st) * GRR_SLOTS, 0);
+    plan->gw_of_st.resize(static_cast<size_t>(n_st));
+    plan->ow_of_st.resize(static_cast<size_t>(n_st));
+    plan->first_of_ow.resize(static_cast<size_t>(n_st));
+    int32_t st = 0;
+    int64_t prev_ow = -1;
+    for (int64_t ow = 0; ow < n_ow; ++ow) {
+      if (ow_present[ow]) {
+        for (int64_t g = 0; g < n_gw; ++g) {
+          const int64_t b = ow * n_gw + g;
+          if (cnt_bk[b] <= 0) continue;
+          st_of_bk[b] = st;
+          plan->gw_of_st[st] = static_cast<int32_t>(g);
+          plan->ow_of_st[st] = static_cast<int32_t>(ow);
+          plan->first_of_ow[st] = (ow != prev_ow) ? 1 : 0;
+          prev_ow = ow;
+          ++st;
+        }
+      } else {
+        plan->gw_of_st[st] = 0;
+        plan->ow_of_st[st] = static_cast<int32_t>(ow);
+        plan->first_of_ow[st] = 1;
+        prev_ow = ow;
+        ++st;
+      }
+    }
+  }
+
+  // Pass C: fill HI/VALS/DST + occupancy bitmaps + spill COO.
+  const int64_t n_st = plan->n_st;
+  std::vector<uint64_t> occ_s(static_cast<size_t>(n_st) * (GRR_SLOTS / 64), 0);
+  std::vector<uint64_t> occ_f(static_cast<size_t>(n_st) * (GRR_SLOTS / 64), 0);
+  {
+    std::fill(r2cnt.begin(), r2cnt.end(), 0);
+    if (!sorted) std::fill(qcnt.begin(), qcnt.end(), 0);
+    int64_t run_key = -1, run_q = 0;
+    for (int64_t e = 0; e < m_ell; ++e) {
+      const float v = vals[e];
+      if (v == 0.0f) continue;
+      const int64_t r = e / k;
+      const int64_t c = cols[e];
+      const int64_t idx = direction ? r : c;
+      const int64_t seg = direction ? c : r;
+      const int64_t gw = idx / GRR_WIN;
+      int64_t q;
+      if (sorted) {
+        const int64_t key = seg * n_gw + gw;
+        if (key != run_key) { run_key = key; run_q = 0; }
+        q = run_q++;
+      } else {
+        uint8_t& qc = qcnt[seg * n_gw + gw];
+        q = qc;
+        if (qc < 255) ++qc;
+      }
+      bool spilled = q >= cap;
+      int64_t l_s = 0;
+      const int64_t bk = (seg / segwin) * n_gw + gw;
+      const int64_t rho = idx % GRR_TILE;
+      if (!spilled) {
+        uint16_t& r2 = r2cnt[bk * GRR_TILE + rho];
+        l_s = r2;
+        ++r2;
+        spilled = l_s >= GRR_TILE;
+      }
+      if (spilled) {
+        plan->spill_idx.push_back(static_cast<int32_t>(idx));
+        plan->spill_seg.push_back(static_cast<int32_t>(seg));
+        plan->spill_val.push_back(v);
+        continue;
+      }
+      const int64_t st = st_of_bk[bk];
+      const int64_t b = seg % segwin;
+      const int64_t s_start = rho * GRR_TILE + l_s;
+      const int64_t s_final =
+          (q * group + b / GRR_TILE) * GRR_TILE + (b % GRR_TILE);
+      const int64_t base = st * GRR_SLOTS;
+      plan->hi[base + s_start] =
+          static_cast<int8_t>((idx % GRR_WIN) / GRR_TILE);
+      plan->vals[base + s_final] = v;
+      plan->dst[base + s_start] = static_cast<int32_t>(s_final);
+      occ_s[(base + s_start) >> 6] |= (uint64_t{1} << (s_start & 63));
+      occ_f[(base + s_final) >> 6] |= (uint64_t{1} << (s_final & 63));
+    }
+  }
+
+  // Pass D: padding bijection — pair free starts with free finals in
+  // order (same construction as the Python path).
+  for (int64_t st = 0; st < n_st; ++st) {
+    const int64_t base = st * GRR_SLOTS;
+    int64_t f = 0;  // next candidate free final
+    for (int64_t s = 0; s < GRR_SLOTS; ++s) {
+      if (occ_s[(base + s) >> 6] & (uint64_t{1} << (s & 63))) continue;
+      while (f < GRR_SLOTS &&
+             (occ_f[(base + f) >> 6] & (uint64_t{1} << (f & 63))))
+        ++f;
+      plan->dst[base + s] = static_cast<int32_t>(f);
+      ++f;
+    }
+  }
+
+  // Spill padding to a multiple of 8.
+  {
+    const int64_t m = static_cast<int64_t>(plan->spill_idx.size());
+    const int64_t m_pad = m ? ((m + 7) / 8) * 8 : 0;
+    plan->spill_idx.resize(static_cast<size_t>(m_pad), 0);
+    plan->spill_seg.resize(static_cast<size_t>(m_pad), 0);
+    plan->spill_val.resize(static_cast<size_t>(m_pad), 0.0f);
+    plan->n_spill = m_pad;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pml_grr_plan(const int32_t* cols, const float* vals, int64_t n,
+                   int64_t k, int32_t direction, int64_t table_len,
+                   int64_t n_segments, int32_t cap_in) {
+  auto* plan = new (std::nothrow) GrrPlan();
+  if (!plan) return nullptr;
+  try {
+    grr_plan_body(plan, cols, vals, n, k, direction, table_len,
+                  n_segments, cap_in);
+  } catch (const std::bad_alloc&) {
+    plan->error = 2;  // caller falls back to the numpy path
+  }
+  return plan;
+}
+
+void pml_grr_plan_sizes(void* handle, int64_t* n_st, int64_t* n_spill,
+                        int32_t* cap, int32_t* n_gw, int32_t* n_ow,
+                        int32_t* error) {
+  auto* p = static_cast<GrrPlan*>(handle);
+  *n_st = p->n_st;
+  *n_spill = p->n_spill;
+  *cap = p->cap;
+  *n_gw = p->n_gw;
+  *n_ow = p->n_ow;
+  *error = p->error;
+}
+
+void pml_grr_plan_fill(void* handle, int8_t* hi, float* vals, int32_t* dst,
+                       int32_t* gw_of_st, int32_t* ow_of_st,
+                       int32_t* first_of_ow, int32_t* spill_idx,
+                       int32_t* spill_seg, float* spill_val) {
+  auto* p = static_cast<GrrPlan*>(handle);
+  std::memcpy(hi, p->hi.data(), p->hi.size());
+  std::memcpy(vals, p->vals.data(), p->vals.size() * sizeof(float));
+  std::memcpy(dst, p->dst.data(), p->dst.size() * sizeof(int32_t));
+  std::memcpy(gw_of_st, p->gw_of_st.data(),
+              p->gw_of_st.size() * sizeof(int32_t));
+  std::memcpy(ow_of_st, p->ow_of_st.data(),
+              p->ow_of_st.size() * sizeof(int32_t));
+  std::memcpy(first_of_ow, p->first_of_ow.data(),
+              p->first_of_ow.size() * sizeof(int32_t));
+  if (p->n_spill) {
+    std::memcpy(spill_idx, p->spill_idx.data(),
+                p->spill_idx.size() * sizeof(int32_t));
+    std::memcpy(spill_seg, p->spill_seg.data(),
+                p->spill_seg.size() * sizeof(int32_t));
+    std::memcpy(spill_val, p->spill_val.data(),
+                p->spill_val.size() * sizeof(float));
+  }
+}
+
+void pml_grr_plan_free(void* handle) { delete static_cast<GrrPlan*>(handle); }
+
+}  // extern "C"

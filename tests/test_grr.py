@@ -269,3 +269,56 @@ def test_objective_grr_matches_ell(rng):
     ga = jax.grad(lambda w: obj.value(w, b_grr))(w)
     np.testing.assert_allclose(np.asarray(ga), np.asarray(g1_),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_native_plan_matches_python_plan(rng):
+    """The C++ plan builder (pml_grr_plan) and the numpy path choose
+    ranks differently (scan vs sort order) but must produce plans whose
+    contractions agree — and match the dense reference."""
+    import jax.numpy as jnp
+
+    import photon_ml_tpu.native as nat
+    from photon_ml_tpu.data.grr import build_grr_pair
+
+    if not nat.native_available():
+        pytest.skip("native library unavailable")
+    n, d, k = 700, 17000, 6
+    block = d // k
+    cols = np.minimum(
+        (np.arange(k)[None, :] * block) + rng.integers(0, block, (n, k)),
+        d - 1).astype(np.int32)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    vals[rng.random((n, k)) < 0.15] = 0.0   # real zero entries drop
+
+    pair_native = build_grr_pair(cols, vals, d)
+    saved = nat._lib
+    nat._lib = None   # force the numpy path
+    try:
+        pair_python = build_grr_pair(cols, vals, d)
+    finally:
+        nat._lib = saved
+
+    w = jnp.asarray(rng.normal(size=d), jnp.float32)
+    r = jnp.asarray(rng.normal(size=n), jnp.float32)
+    np.testing.assert_allclose(np.asarray(pair_native.dot(w)),
+                               np.asarray(pair_python.dot(w)),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(pair_native.t_dot(r)),
+                               np.asarray(pair_python.t_dot(r)),
+                               rtol=2e-4, atol=2e-4)
+    x = np.zeros((n, d), np.float32)
+    np.add.at(x, (np.repeat(np.arange(n), k), cols.reshape(-1)),
+              vals.reshape(-1))
+    np.testing.assert_allclose(np.asarray(pair_native.dot(w)),
+                               x @ np.asarray(w), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(pair_native.t_dot(r)),
+                               x.T @ np.asarray(r), rtol=2e-3, atol=2e-3)
+
+
+def test_bad_cap_rejected_both_paths(rng):
+    from photon_ml_tpu.data.grr import build_grr_pair
+
+    cols = rng.integers(0, 50, (20, 3)).astype(np.int32)
+    vals = rng.normal(size=(20, 3)).astype(np.float32)
+    with pytest.raises(ValueError, match="cap"):
+        build_grr_pair(cols, vals, 50, cap=48)
