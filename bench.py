@@ -57,16 +57,22 @@ def _make_ell(n: int, d: int, k: int, seed: int = 0):
 
 def _grr_stream_bytes(pair) -> int:
     """Bytes the GRR plan actually moves per fused value+gradient step:
-    both directions' (vals f32 + 3 route planes i8) streams, spill COO,
-    table windows, and the dense hot side."""
-    total = 0
-    for d_ in (pair.row_dir, pair.col_dir):
+    both directions' (vals f32 + 3 route planes i8) streams — including
+    each direction's second-level overflow plan — spill COO, table
+    windows, and the dense hot side."""
+
+    def direction_bytes(d_) -> int:
         slots = d_.n_supertiles * 16384
-        total += slots * (4 + 3)                      # vals + g1/g2/g3
-        total += d_.n_spill * 12                      # spill idx/seg/val
+        b = slots * (4 + 3)                           # vals + g1/g2/g3
+        b += d_.n_spill * 12                          # spill idx/seg/val
         # One [128,128] table window is (re)streamed per supertile (the
         # kernel fetches the block its gw index selects each grid step).
-        total += d_.n_supertiles * 16384 * 4
+        b += d_.n_supertiles * 16384 * 4
+        if d_.overflow is not None:
+            b += direction_bytes(d_.overflow)
+        return b
+
+    total = direction_bytes(pair.row_dir) + direction_bytes(pair.col_dir)
     total += int(np.prod(pair.x_hot.shape)) * 4 * 2   # dense side, 2 dirs
     return total
 

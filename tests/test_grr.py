@@ -322,3 +322,49 @@ def test_bad_cap_rejected_both_paths(rng):
     vals = rng.normal(size=(20, 3)).astype(np.float32)
     with pytest.raises(ValueError, match="cap"):
         build_grr_pair(cols, vals, 50, cap=48)
+
+
+def test_overflow_level_absorbs_spill(rng):
+    """Two-level plan: heavy-tail spill recompiled at a larger cap; the
+    overflow contraction must reproduce the single-level result and the
+    dense reference."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.grr import build_grr_pair
+
+    n, d, k = 600, 300, 6
+    # Skewed columns: a few columns soak up most entries (below the
+    # dense-hot threshold, above per-window cap) -> guaranteed spill.
+    cols = np.where(
+        rng.random((n, k)) < 0.5,
+        rng.integers(0, 8, (n, k)),
+        rng.integers(0, d, (n, k)),
+    ).astype(np.int32)
+    cols = np.sort(cols, axis=1)
+    for j in range(1, k):
+        bump = cols[:, j] <= cols[:, j - 1]
+        cols[bump, j] = cols[bump, j - 1] + 1
+    cols = np.minimum(cols, d - 1)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+
+    plain = build_grr_pair(cols, vals, d, hot_threshold=10**9,
+                           overflow_threshold=10**9)
+    two_level = build_grr_pair(cols, vals, d, hot_threshold=10**9,
+                               overflow_threshold=1)
+    assert (two_level.col_dir.overflow is not None
+            or two_level.row_dir.overflow is not None), \
+        "expected at least one direction to carry an overflow plan"
+
+    w = jnp.asarray(rng.normal(size=d), jnp.float32)
+    r = jnp.asarray(rng.normal(size=n), jnp.float32)
+    np.testing.assert_allclose(np.asarray(two_level.dot(w)),
+                               np.asarray(plain.dot(w)),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(two_level.t_dot(r)),
+                               np.asarray(plain.t_dot(r)),
+                               rtol=2e-4, atol=2e-4)
+    # Hessian-diagonal path recurses into the overflow too.
+    np.testing.assert_allclose(
+        np.asarray(two_level.squared().t_dot(jnp.abs(r))),
+        np.asarray(plain.squared().t_dot(jnp.abs(r))),
+        rtol=2e-4, atol=2e-4)
