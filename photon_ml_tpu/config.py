@@ -206,13 +206,6 @@ class TrainingConfig:
         if self.n_devices is not None:
             if self.n_devices <= 0:
                 raise ValueError("n_devices must be positive")
-            if self.sparse_layout not in ("AUTO", "COLMAJOR"):
-                raise ValueError(
-                    f"sparse_layout={self.sparse_layout} is not available "
-                    "with mesh training (n_devices): sharded batches use "
-                    "per-shard COLMAJOR layouts (the GRR plan is not yet "
-                    "mesh-sharded)"
-                )
             for c in self.coordinates:
                 if c.down_sampling_rate is not None:
                     raise ValueError(

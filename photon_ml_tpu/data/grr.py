@@ -178,7 +178,7 @@ class GrrDirection:
 
 
 def _spill_overflow(s_idx, s_seg, s_val, m_real, table_len, n_segments,
-                    validate, threshold):
+                    validate, threshold, device=True):
     """Compile the COO spill into a second-level plan when it is big
     enough to matter (one level deep; the level-2 residual stays COO).
     Operates on HOST arrays, before any device placement — pulling
@@ -212,6 +212,7 @@ def _spill_overflow(s_idx, s_seg, s_val, m_real, table_len, n_segments,
         val=np.asarray(s_val[:m_real]),
         table_len=table_len, n_segments=n_segments,
         cap=None, validate=validate, overflow_threshold=None,
+        device=device,
     )
     if lvl2.n_supertiles * SLOTS > 96 * m_real:
         return None, s_idx, s_seg, s_val
@@ -220,14 +221,20 @@ def _spill_overflow(s_idx, s_seg, s_val, m_real, table_len, n_segments,
 
 
 def _native_direction(cols, vals_masked, direction, table_len, n_segments,
-                      cap, validate,
-                      overflow_threshold) -> "GrrDirection | None":
+                      cap, validate, overflow_threshold,
+                      device=True) -> "GrrDirection | None":
     """One direction's plan via the C++ builder (``pml_grr_plan``), or
     None when the native library is unavailable / declines the shape.
     Rank assignment differs from the numpy path (scan order vs sort
-    order) — both are valid plans; contractions agree (tested)."""
+    order) — both are valid plans; contractions agree (tested).
+
+    ``device=False`` keeps the plan's leaves as host numpy arrays —
+    the mesh-sharded build pads shard plans to a common shape on the
+    host before placing each on its own device (one transfer, no
+    device round-trip)."""
     from photon_ml_tpu.native import grr_plan_native, grr_routes_native
 
+    conv = jnp.asarray if device else np.asarray
     plan = grr_plan_native(cols, vals_masked, direction, table_len,
                            n_segments, cap)
     if plan is None:
@@ -242,7 +249,7 @@ def _native_direction(cols, vals_masked, direction, table_len, n_segments,
     total = m + int(np.count_nonzero(plan["vals"]))
     overflow, s_idx, s_seg, s_val = _spill_overflow(
         plan["spill_idx"], plan["spill_seg"], plan["spill_val"], m,
-        table_len, n_segments, validate, overflow_threshold,
+        table_len, n_segments, validate, overflow_threshold, device=device,
     )
     # Warn only about spill that STAYS on the XLA scatter path — spill
     # absorbed into the overflow plan runs at kernel speed and needs no
@@ -255,14 +262,14 @@ def _native_direction(cols, vals_masked, direction, table_len, n_segments,
             100 * m_coo / total, m_coo, total
         )
     return GrrDirection(
-        g1=jnp.asarray(G1), g2=jnp.asarray(G2), g3=jnp.asarray(G3),
-        vals=jnp.asarray(plan["vals"]),
-        gw_of_st=jnp.asarray(plan["gw_of_st"]),
-        ow_of_st=jnp.asarray(plan["ow_of_st"]),
-        first_of_ow=jnp.asarray(plan["first_of_ow"]),
-        spill_idx=jnp.asarray(s_idx),
-        spill_seg=jnp.asarray(s_seg),
-        spill_val=jnp.asarray(s_val),
+        g1=conv(G1), g2=conv(G2), g3=conv(G3),
+        vals=conv(plan["vals"]),
+        gw_of_st=conv(plan["gw_of_st"]),
+        ow_of_st=conv(plan["ow_of_st"]),
+        first_of_ow=conv(plan["first_of_ow"]),
+        spill_idx=conv(s_idx),
+        spill_seg=conv(s_seg),
+        spill_val=conv(s_val),
         table_len=table_len, n_segments=n_segments, cap=plan["cap"],
         n_gw=plan["n_gw"], n_ow=plan["n_ow"], overflow=overflow,
     )
@@ -277,12 +284,14 @@ def build_grr_direction(
     cap: int | None = None,
     validate: bool = True,
     overflow_threshold: int | None = None,
+    device: bool = True,
 ) -> GrrDirection:
     """Compile one direction's plan from COO (idx, seg, val).
 
     Entries with val == 0 are dropped.  ``cap`` (slots per segment per
     table-window) defaults to a heuristic from the occupancy
     distribution; overflow spills to the COO fallback.
+    ``device=False`` keeps leaves as host numpy (see _native_direction).
     """
     import time as _time
 
@@ -460,7 +469,7 @@ def build_grr_direction(
 
     overflow, s_idx, s_seg, s_val = _spill_overflow(
         s_idx, s_seg, s_val, m, table_len, n_segments, validate,
-        overflow_threshold,
+        overflow_threshold, device=device,
     )
     # Warn only about spill that stays on the XLA scatter path (spill
     # absorbed by the overflow plan runs at kernel speed).
@@ -472,14 +481,15 @@ def build_grr_direction(
             100 * m_coo / max(idx.size, 1), m_coo, idx.size
         )
     _mark("spill")
+    conv = jnp.asarray if device else np.asarray
     return GrrDirection(
-        g1=jnp.asarray(G1), g2=jnp.asarray(G2), g3=jnp.asarray(G3),
-        vals=jnp.asarray(VALS),
-        gw_of_st=jnp.asarray(gw_of_st),
-        ow_of_st=jnp.asarray(ow_of_st),
-        first_of_ow=jnp.asarray(first_of_ow),
-        spill_idx=jnp.asarray(s_idx), spill_seg=jnp.asarray(s_seg),
-        spill_val=jnp.asarray(s_val),
+        g1=conv(G1), g2=conv(G2), g3=conv(G3),
+        vals=conv(VALS),
+        gw_of_st=conv(gw_of_st),
+        ow_of_st=conv(ow_of_st),
+        first_of_ow=conv(first_of_ow),
+        spill_idx=conv(s_idx), spill_seg=conv(s_seg),
+        spill_val=conv(s_val),
         table_len=table_len, n_segments=n_segments, cap=cap,
         n_gw=n_gw, n_ow=n_ow, overflow=overflow,
     )
@@ -507,6 +517,30 @@ def _validate_routes(G2, G3) -> None:
             )
 
 
+def _select_hot(counts: np.ndarray, threshold: int,
+                max_hot: int) -> np.ndarray:
+    """Hot-column ids from occupancy counts (top-``max_hot`` above
+    ``threshold``)."""
+    hot = np.flatnonzero(counts > threshold)
+    if hot.size > max_hot:
+        order = np.argsort(counts[hot])[::-1]
+        hot = np.sort(hot[order[:max_hot]])
+    return hot
+
+
+def _apply_hot_split(cols, vals, dim, n_rows, hot):
+    """Densify a given hot id set out of an ELL batch →
+    (x_hot [n_rows, H] f32, keep_mask [n, k])."""
+    nz = vals != 0
+    pos = np.full(dim, -1, np.int64)
+    pos[hot] = np.arange(hot.size)
+    is_hot = nz & (pos[cols] >= 0)
+    x_hot = np.zeros((n_rows, hot.size), np.float32)
+    r_idx, k_idx = np.nonzero(is_hot)
+    np.add.at(x_hot, (r_idx, pos[cols[r_idx, k_idx]]), vals[r_idx, k_idx])
+    return x_hot, nz & ~is_hot
+
+
 def dense_hot_split(
     cols: np.ndarray,
     vals: np.ndarray,
@@ -522,22 +556,11 @@ def dense_hot_split(
     """
     cols = np.asarray(cols)
     vals = np.asarray(vals, np.float32)
-    nz = vals != 0
-    counts = np.bincount(cols[nz].reshape(-1), minlength=dim)
+    counts = np.bincount(cols[vals != 0].reshape(-1), minlength=dim)
     if threshold is None:
         threshold = max(64, n_rows // 16)
-    hot = np.flatnonzero(counts > threshold)
-    if hot.size > max_hot:
-        order = np.argsort(counts[hot])[::-1]
-        hot = np.sort(hot[order[:max_hot]])
-    H = hot.size
-    pos = np.full(dim, -1, np.int64)
-    pos[hot] = np.arange(H)
-    is_hot = nz & (pos[cols] >= 0)
-    x_hot = np.zeros((n_rows, H), np.float32)
-    r_idx, k_idx = np.nonzero(is_hot)
-    np.add.at(x_hot, (r_idx, pos[cols[r_idx, k_idx]]), vals[r_idx, k_idx])
-    keep = nz & ~is_hot
+    hot = _select_hot(counts, threshold, max_hot)
+    x_hot, keep = _apply_hot_split(cols, vals, dim, n_rows, hot)
     return hot.astype(np.int32), x_hot, keep
 
 
@@ -658,27 +681,209 @@ def build_grr_pair(
     # direction falls back independently (the directions are built
     # independently either way).
     vals_masked = np.where(keep, vals, np.float32(0.0))
-    row_dir = _native_direction(cols, vals_masked, 0, dim, n, cap, validate,
-                                overflow_threshold=overflow_threshold)
-    col_dir = _native_direction(cols, vals_masked, 1, n, dim, cap, validate,
-                                overflow_threshold=overflow_threshold)
-    if row_dir is None or col_dir is None:
-        r_idx, k_idx = np.nonzero(keep)
-        c = cols[r_idx, k_idx].astype(np.int64)
-        v = vals[r_idx, k_idx]
-        if row_dir is None:
-            row_dir = build_grr_direction(
-                idx=c, seg=r_idx.astype(np.int64), val=v,
-                table_len=dim, n_segments=n, cap=cap, validate=validate,
-                overflow_threshold=overflow_threshold,
-            )
-        if col_dir is None:
-            col_dir = build_grr_direction(
-                idx=r_idx.astype(np.int64), seg=c, val=v,
-                table_len=n, n_segments=dim, cap=cap, validate=validate,
-                overflow_threshold=overflow_threshold,
-            )
+    row_dir = _build_direction_ell(cols, vals_masked, 0, dim, n, cap,
+                                   validate, overflow_threshold)
+    col_dir = _build_direction_ell(cols, vals_masked, 1, n, dim, cap,
+                                   validate, overflow_threshold)
     return GrrPair(
         row_dir=row_dir, col_dir=col_dir,
         hot_ids=jnp.asarray(hot_ids), x_hot=jnp.asarray(x_hot),
     )
+
+
+def _build_direction_ell(cols, vals_masked, direction, table_len,
+                         n_segments, cap, validate, overflow_threshold,
+                         device=True) -> GrrDirection:
+    """One direction straight from (hot-masked) ELL arrays: native C++
+    builder first, numpy COO path as the fallback."""
+    d = _native_direction(cols, vals_masked, direction, table_len,
+                          n_segments, cap, validate, overflow_threshold,
+                          device=device)
+    if d is not None:
+        return d
+    r_idx, k_idx = np.nonzero(vals_masked != 0)
+    c = cols[r_idx, k_idx].astype(np.int64)
+    v = vals_masked[r_idx, k_idx]
+    idx, seg = ((c, r_idx.astype(np.int64)) if direction == 0
+                else (r_idx.astype(np.int64), c))
+    return build_grr_direction(
+        idx=idx, seg=seg, val=v, table_len=table_len,
+        n_segments=n_segments, cap=cap, validate=validate,
+        overflow_threshold=overflow_threshold, device=device,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded plans: per-device GrrPairs with mesh-uniform structure.
+#
+# Under data parallelism each device owns a contiguous row shard; its
+# row_dir contracts the replicated w over local rows and its col_dir
+# produces the [dim] gradient PARTIAL that the distributed objective's
+# existing psum combines — the same contract the colmajor sharding
+# satisfies, now at kernel speed (the north star's "pmapped Pallas
+# kernel over an HBM-sharded CSR + ICI allreduce", BASELINE.json).
+#
+# jax assembles the shards into one global array per leaf
+# (make_array_from_single_device_arrays), which requires every shard's
+# pytree to have IDENTICAL structure and leaf shapes.  Three things are
+# therefore forced mesh-uniform at build time:
+#   * cap (static metadata, per direction): chosen by shard 0's
+#     occupancy heuristic, reused by all shards;
+#   * the hot-column set: computed from GLOBAL column counts so every
+#     shard's dense side has the same [H] ids (each with its own rows);
+#   * the two-level overflow: decided on the POOLED spill count, built
+#     per shard with a common level-2 cap, or for nobody.
+# Remaining shape differences (supertile count, spill length) are
+# closed by padding with zero-valued dummy supertiles / COO entries,
+# which contribute exactly zero to the contraction.
+# ---------------------------------------------------------------------------
+
+
+def _pad_grr_direction(d: GrrDirection, n_st: int, n_spill: int,
+                       ovf_pad=None) -> GrrDirection:
+    """Pad a host-built plan to (n_st supertiles, n_spill COO entries).
+
+    Dummy supertiles carry vals=0 (zero contribution), gw=0 (any valid
+    window), ow=n_ow-1 with first_of_ow=0 — appended after the real
+    tiles they extend the last output-window run, so the kernel's
+    accumulate-in-VMEM grid order stays valid."""
+    rep = {}
+    add = n_st - d.n_supertiles
+    if add:
+        z3 = lambda a, dt: np.concatenate(
+            [np.asarray(a), np.zeros((add,) + np.asarray(a).shape[1:], dt)])
+        rep.update(
+            g1=z3(d.g1, np.int8), g2=z3(d.g2, np.int8), g3=z3(d.g3, np.int8),
+            vals=z3(d.vals, np.float32),
+            gw_of_st=np.concatenate(
+                [np.asarray(d.gw_of_st), np.zeros(add, np.int32)]),
+            ow_of_st=np.concatenate(
+                [np.asarray(d.ow_of_st),
+                 np.full(add, d.n_ow - 1, np.int32)]),
+            first_of_ow=np.concatenate(
+                [np.asarray(d.first_of_ow), np.zeros(add, np.int32)]),
+        )
+    madd = n_spill - d.n_spill
+    if madd:
+        rep.update(
+            spill_idx=np.pad(np.asarray(d.spill_idx), (0, madd)),
+            spill_seg=np.pad(np.asarray(d.spill_seg), (0, madd)),
+            spill_val=np.pad(np.asarray(d.spill_val), (0, madd)),
+        )
+    if ovf_pad is not None and d.overflow is not None:
+        rep["overflow"] = _pad_grr_direction(d.overflow, *ovf_pad)
+    return d.replace(**rep) if rep else d
+
+
+def _pool_overflow(dirs: list, table_len: int, n_segments: int,
+                   validate: bool, threshold: int | None) -> list:
+    """The sharded build's two-level-overflow decision, made once on the
+    pooled spill (all-or-none, so shard pytrees stay congruent).  Same
+    economics as ``_spill_overflow``: absorb the heavy tail at kernel
+    speed while the level-2 plans stream < ~96 slots per entry."""
+    ms = [int(np.count_nonzero(np.asarray(d.spill_val))) for d in dirs]
+    total = sum(ms)
+    if threshold is None or total <= threshold:
+        return dirs
+    st_floor = -(-n_segments // (WIN // 4))
+    if st_floor * SLOTS * len(dirs) > 96 * total:
+        return dirs
+    order = sorted(range(len(dirs)), key=lambda i: -ms[i])
+    l2cap = None
+    lvl2: list = [None] * len(dirs)
+    for i in order:
+        d = dirs[i]
+        lvl2[i] = build_grr_direction(
+            idx=np.asarray(d.spill_idx, np.int64),
+            seg=np.asarray(d.spill_seg, np.int64),
+            val=np.asarray(d.spill_val),
+            table_len=table_len, n_segments=n_segments, cap=l2cap,
+            validate=validate, overflow_threshold=None, device=False,
+        )
+        if l2cap is None:
+            l2cap = lvl2[i].cap
+    if sum(x.n_supertiles for x in lvl2) * SLOTS > 96 * total:
+        return dirs
+    z = np.zeros(0, np.int32)
+    return [
+        d.replace(overflow=l2, spill_idx=z, spill_seg=z,
+                  spill_val=np.zeros(0, np.float32))
+        for d, l2 in zip(dirs, lvl2)
+    ]
+
+
+def _pad_dirs_common(dirs: list) -> list:
+    """Pad every shard's plan (and level-2 plan) to the max shapes."""
+    n_st = max(d.n_supertiles for d in dirs)
+    n_sp = max(d.n_spill for d in dirs)
+    ovf_pad = None
+    if dirs[0].overflow is not None:  # all-or-none by construction
+        ovf_pad = (max(d.overflow.n_supertiles for d in dirs),
+                   max(d.overflow.n_spill for d in dirs))
+    return [_pad_grr_direction(d, n_st, n_sp, ovf_pad) for d in dirs]
+
+
+def build_sharded_grr_pairs(
+    shard_cols: list[np.ndarray],
+    shard_vals: list[np.ndarray],
+    dim: int,
+    cap: int | None = None,
+    hot_threshold: int | None = None,
+    max_hot: int = 128,
+    validate: bool = True,
+    overflow_threshold: int = 16384,
+) -> list[GrrPair]:
+    """Compile per-shard GRR plans over equal-size row shards.
+
+    ``shard_cols``/``shard_vals``: one [per, k] ELL pair per device
+    (already padded to equal row counts).  Returns one ``GrrPair`` per
+    shard with HOST (numpy) leaves and identical pytree structure +
+    leaf shapes, ready for ``jax.make_array_from_single_device_arrays``
+    assembly (``parallel.mesh.shard_sparse_batch(layout="grr")``).
+    """
+    n_shards = len(shard_cols)
+    per = shard_cols[0].shape[0]
+    n_total = per * n_shards
+
+    # Global hot-column split: one hot id set for every shard.
+    counts = np.zeros(dim, np.int64)
+    for c, v in zip(shard_cols, shard_vals):
+        nz = np.asarray(v) != 0
+        counts += np.bincount(
+            np.asarray(c)[nz].reshape(-1), minlength=dim)
+    if hot_threshold is None:
+        # Same economics as build_grr_pair, scaled to the shard-local
+        # col_dir window count (a column overflows per-shard windows).
+        n_row_windows = max(1, -(-per // WIN)) * n_shards
+        hot_threshold = min(max(64, n_total // 16), 48 * n_row_windows)
+    hot = _select_hot(counts, hot_threshold, max_hot)
+    hot_ids = hot.astype(np.int32)
+
+    row_dirs, col_dirs, x_hots = [], [], []
+    row_cap, col_cap = cap, cap
+    for c, v in zip(shard_cols, shard_vals):
+        c = np.asarray(c)
+        v = np.asarray(v, np.float32)
+        x_hot, keep = _apply_hot_split(c, v, dim, per, hot)
+        vm = np.where(keep, v, np.float32(0.0))
+        rd = _build_direction_ell(c, vm, 0, dim, per, row_cap, validate,
+                                  None, device=False)
+        row_cap = row_cap or rd.cap
+        cd_ = _build_direction_ell(c, vm, 1, per, dim, col_cap, validate,
+                                   None, device=False)
+        col_cap = col_cap or cd_.cap
+        row_dirs.append(rd)
+        col_dirs.append(cd_)
+        x_hots.append(x_hot)
+
+    row_dirs = _pool_overflow(row_dirs, dim, per, validate,
+                              overflow_threshold)
+    col_dirs = _pool_overflow(col_dirs, per, dim, validate,
+                              overflow_threshold)
+    row_dirs = _pad_dirs_common(row_dirs)
+    col_dirs = _pad_dirs_common(col_dirs)
+    return [
+        GrrPair(row_dir=rd, col_dir=cd_, hot_ids=hot_ids.copy(),
+                x_hot=xh)
+        for rd, cd_, xh in zip(row_dirs, col_dirs, x_hots)
+    ]

@@ -193,15 +193,21 @@ class GameEstimator:
                 intercept_index = dim
                 dim += 1
             if mesh is not None:
-                # Mesh path: per-shard layouts (each device's transposed
-                # copy indexes its own rows; SURVEY §5.8's one-time
-                # "shuffle").  The GRR plan is not yet mesh-sharded —
-                # colmajor is the sharded layout.
+                # Mesh path: per-shard layouts (each device indexes its
+                # own rows; SURVEY §5.8's one-time "shuffle").  AUTO
+                # picks the sharded GRR compiled plans on TPU — the fast
+                # path IS the distributed path — and colmajor elsewhere.
                 from photon_ml_tpu.parallel import shard_sparse_batch
 
+                layout = cfg.sparse_layout
+                if layout == "AUTO":
+                    import jax
+
+                    layout = ("GRR" if jax.default_backend() == "tpu"
+                              else "COLMAJOR")
                 batch = shard_sparse_batch(
                     rows, dim, labels, mesh, weights=weights,
-                    col_major=True,
+                    layout=layout.lower(),
                 )
             else:
                 # Layout: the GRR compiled plan is the fast TPU path

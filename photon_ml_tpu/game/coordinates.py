@@ -156,6 +156,14 @@ def _score_batch(batch, w: Array) -> Array:
     return batch.x_dot(w)
 
 
+@jax.jit
+def _score_batch_distributed(dist_obj, batch, w: Array) -> Array:
+    """Sharded scoring: per-shard layouts (GRR plan / colmajor) index
+    only their device's rows, so X·w must run under shard_map.  Module
+    -level jit so per-CD-iteration scoring hits the compile cache."""
+    return dist_obj.x_dot(w, batch)
+
+
 def _re_block_batch(blocks, b: int, offsets: Array) -> DenseBatch:
     """Bucket b's entity blocks as one vmappable DenseBatch, with
     per-example offsets scattered into block space."""
@@ -276,7 +284,11 @@ class FixedEffectCoordinate(Coordinate):
         return res.w, res
 
     def score(self, coefficients: Array) -> Array:
-        scores = _score_batch(self.batch, coefficients)
+        if self.distributed is not None:
+            scores = _score_batch_distributed(
+                self.distributed, self.batch, coefficients)
+        else:
+            scores = _score_batch(self.batch, coefficients)
         if (self.n_examples is not None
                 and self.n_examples != self.batch.n_padded):
             scores = scores[: self.n_examples]

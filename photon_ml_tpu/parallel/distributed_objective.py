@@ -63,7 +63,10 @@ class DistributedGLMObjective:
 
     # Each method shard_maps a closure running the LOCAL fused pipeline and
     # psumming the [dim]-or-scalar partials.  w is replicated (in_spec P()),
-    # batch leaves are example-sharded (P('data')).
+    # batch leaves are example-sharded (P('data')).  check_vma=False:
+    # pallas_call (the GRR kernel) cannot annotate varying-mesh-axes on
+    # its out_shape, which vma checking requires of everything inside a
+    # shard_map.
 
     def value(self, w: Array, batch: Batch) -> Array:
         def local(w, batch):
@@ -71,7 +74,7 @@ class DistributedGLMObjective:
 
         val = jax.shard_map(
             local, mesh=self.mesh, in_specs=(P(), batch_spec()),
-            out_specs=P(),
+            out_specs=P(), check_vma=False,
         )(w, batch)
         return val + self.objective.reg.l2_value(w)
 
@@ -82,7 +85,7 @@ class DistributedGLMObjective:
 
         v, g = jax.shard_map(
             local, mesh=self.mesh, in_specs=(P(), batch_spec()),
-            out_specs=(P(), P()),
+            out_specs=(P(), P()), check_vma=False,
         )(w, batch)
         reg = self.objective.reg
         return v + reg.l2_value(w), g + reg.l2_gradient(w)
@@ -98,7 +101,7 @@ class DistributedGLMObjective:
 
         hv = jax.shard_map(
             local, mesh=self.mesh, in_specs=(P(), P(), batch_spec()),
-            out_specs=P(),
+            out_specs=P(), check_vma=False,
         )(w, v, batch)
         return hv + self.objective.reg.l2_hessian_vector(v)
 
@@ -110,7 +113,7 @@ class DistributedGLMObjective:
 
         hd = jax.shard_map(
             local, mesh=self.mesh, in_specs=(P(), batch_spec()),
-            out_specs=P(),
+            out_specs=P(), check_vma=False,
         )(w, batch)
         return hd + self.objective.reg.l2_hessian_diagonal(w)
 
@@ -119,5 +122,15 @@ class DistributedGLMObjective:
         return jax.shard_map(
             lambda w, b: self._data_obj.predict_margins(w, b),
             mesh=self.mesh, in_specs=(P(), batch_spec()),
-            out_specs=batch_spec(),
+            out_specs=batch_spec(), check_vma=False,
         )(w, batch)
+
+    def x_dot(self, v: Array, batch: Batch) -> Array:
+        """Raw X·v per example (coordinate scoring).  Must run under
+        shard_map: a per-shard layout (GRR plan / colmajor) indexes only
+        its device's rows, so the contraction is shard-local."""
+        return jax.shard_map(
+            lambda v, b: b.x_dot(v),
+            mesh=self.mesh, in_specs=(P(), batch_spec()),
+            out_specs=batch_spec(), check_vma=False,
+        )(v, batch)

@@ -121,25 +121,38 @@ def shard_sparse_batch(
     row_capacity: int | None = None,
     col_major: bool = True,
     col_capacity: int | None = None,
+    layout: str | None = None,
 ):
     """Host-side ETL: split examples across the mesh, build one
-    SparseBatch per device — each with the transposed-ELL copy of *its
-    own* rows (shard-local ``trows``) — and assemble the global
-    example-sharded arrays.
+    SparseBatch per device — each with the fast-contraction layout of
+    *its own* rows — and assemble the global example-sharded arrays.
 
     This is the rebuild of the reference's one-time ``partitionBy``
     shuffle (SURVEY.md §5.8): after this call every optimizer iteration
     is pure compute + one ``psum``; no per-step data movement.  The
-    per-shard transpose is what keeps the gradient contraction
-    scatter-free under data parallelism: each device computes
-    ``Xᵀ_shard r_shard`` locally (gather+rowsum over local rows), and the
-    partial [dim] gradients are combined by the same ``psum`` that
-    already reduces the loss.
+    per-shard layout is what keeps the gradient contraction scatter-free
+    under data parallelism: each device computes ``Xᵀ_shard r_shard``
+    locally and the partial [dim] gradients are combined by the same
+    ``psum`` that already reduces the loss.
+
+    ``layout`` selects the per-shard contraction layout:
+    - ``"grr"`` — per-device compiled GRR plans run by the Mosaic
+      kernel (``data.grr.build_sharded_grr_pairs``): the fast TPU path,
+      now also the distributed path (BASELINE.json north star);
+    - ``"colmajor"`` (default, = ``col_major=True``) — per-shard
+      transposed-ELL copies;
+    - ``"ell"`` (= ``col_major=False``) — plain ELL shards.
     """
     from photon_ml_tpu.data.batch import make_sparse_batch
     from photon_ml_tpu.data.colmajor import build_colmajor, choose_capacity
 
     from photon_ml_tpu.data.sparse_rows import SparseRows
+
+    if layout is None:
+        layout = "colmajor" if col_major else "ell"
+    if layout not in ("grr", "colmajor", "ell"):
+        raise ValueError(f"unknown layout {layout!r}")
+    col_major = layout == "colmajor"
 
     n = len(labels)
     n_dev = mesh.devices.size
@@ -204,6 +217,15 @@ def shard_sparse_batch(
             ))
             for b in shards
         ]
+    elif layout == "grr":
+        from photon_ml_tpu.data.grr import build_sharded_grr_pairs
+
+        pairs = build_sharded_grr_pairs(
+            [np.asarray(b.col_ids) for b in shards],
+            [np.asarray(b.values) for b in shards],
+            dim,
+        )
+        shards = [b.replace(grr=p) for b, p in zip(shards, pairs)]
 
     devices = list(mesh.devices.flat)
     sharding = NamedSharding(mesh, batch_spec())

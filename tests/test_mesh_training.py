@@ -89,6 +89,22 @@ def test_config1_sparse_mesh_matches_single_device(rng):
     assert r8.evaluations[EvaluatorType.AUC] > 0.8
 
 
+def test_config1_sparse_grr_mesh_matches_single_device(rng):
+    """Round-3 verdict #1: the GRR compiled plan IS the sharded layout.
+    Estimator with sparse_layout=GRR on the 8-device mesh == the
+    single-device GRR fit (tolerance of the colmajor test above)."""
+    ds, _ = _sparse_dataset(rng)
+    r1 = GameEstimator(_fixed_cfg(sparse_layout="GRR")).fit(ds, ds)[0]
+    r8 = GameEstimator(
+        _fixed_cfg(n_devices=8, sparse_layout="GRR")).fit(ds, ds)[0]
+    w1 = np.asarray(r1.model.models["global"].coefficients.means)
+    w8 = np.asarray(r8.model.models["global"].coefficients.means)
+    np.testing.assert_allclose(w8, w1, rtol=5e-3, atol=5e-3)
+    assert abs(r8.evaluations[EvaluatorType.AUC]
+               - r1.evaluations[EvaluatorType.AUC]) < 1e-3
+    assert r8.evaluations[EvaluatorType.AUC] > 0.8
+
+
 def test_config1_tron_mesh_matches_single_device(rng):
     """TRON over the psum objective (the distributed HVP arm)."""
     ds, _ = _sparse_dataset(rng, n=400)
