@@ -65,9 +65,16 @@ def _grr_stream_bytes(pair) -> int:
         slots = d_.n_supertiles * 16384
         b = slots * (4 + 3)                           # vals + g1/g2/g3
         b += d_.n_spill * 12                          # spill idx/seg/val
-        # One [128,128] table window is (re)streamed per supertile (the
-        # kernel fetches the block its gw index selects each grid step).
-        b += d_.n_supertiles * 16384 * 4
+        if d_.dense_grid:
+            # gw-major grid: the window block index only changes between
+            # gw runs, so each [128,128] window streams ONCE per run;
+            # the per-tile partials are written then re-read by the
+            # reshape-sum reduction.
+            b += d_.n_gw * 16384 * 4
+            b += 2 * d_.n_supertiles * (16384 // d_.cap) * 4
+        else:
+            # Legacy order: one window is (re)streamed per supertile.
+            b += d_.n_supertiles * 16384 * 4
         if d_.overflow is not None:
             b += direction_bytes(d_.overflow)
         return b
@@ -129,8 +136,15 @@ def main() -> None:
         return w - 1e-6 * g
 
     results = {}
+    # GRR scan length 250: the production solvers run the WHOLE optimize
+    # loop as one device program (lbfgs/tron while_loop), so per-call
+    # dispatch/fence must be amortized out of the per-step number; the
+    # axon tunnel costs ~100 ms per dispatch+fence round, i.e. ~2 ms/step
+    # of pure measurement artifact at scan length 20 (device traces show
+    # the same program at 4.4 ms/step while length-20 fencing reports
+    # 6.5).  Longer scans converge the fenced number to device time.
     variants = [
-        ("grr", mk(grr=pair), 20, 3),
+        ("grr", mk(grr=pair), 250, 2),
         ("colmajor", mk(colmajor=cm), 4, 2),
         ("segment_sum", mk(), 4, 2),
     ]

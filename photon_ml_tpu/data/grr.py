@@ -17,11 +17,14 @@ primitive:
   = a [128,128] VMEM tile) bounds what the supertile gathers; the
   segment window (16384/CAP segments) bounds what it reduces into.
 - Within a supertile, each element *starts* in the sublane matching its
-  table index's lane residue (idx mod 128) — making the gather ONE
-  lane-gather from the transposed window — and *ends* at its segment's
-  reduction slot, reached by an arbitrary-but-static permutation
-  realized as a 3-stage Clos route (``ops.crossbar``; switches from
-  König edge-coloring, computed here, applied by ``ops.grr_kernel``).
+  table index's window sub-tile ((idx mod WIN) // 128), with the gather
+  plane carrying its lane residue (idx mod 128) — making the gather ONE
+  lane-gather straight from the *untransposed* window (row s of the
+  [128,128] window IS table[gw·WIN + 128s ...]) — and *ends* at its
+  segment's reduction slot, reached by an arbitrary-but-static
+  permutation realized as a 3-stage Clos route (``ops.crossbar``;
+  switches from König edge-coloring, computed here, applied by
+  ``ops.grr_kernel``).
 - Each segment owns CAP slots per table-window (capacity planes are
   contiguous 16-row blocks, so the reduction is CAP static-slice adds);
   per-(segment, window) overflow beyond CAP — and per-residue overflow
@@ -110,6 +113,17 @@ class GrrDirection:
     cap: int = struct.field(pytree_node=False)
     n_gw: int = struct.field(pytree_node=False)
     n_ow: int = struct.field(pytree_node=False)
+    # Dense-grid layout (the fast kernel arrangement, chosen when the
+    # (gw × ow) block grid is ≥ ~70% occupied — true for all production
+    # shapes; level-2 overflow plans are usually sparser and keep the
+    # legacy order):  tiles are gw-major over the FULL padded grid
+    # (missing blocks = zero dummy tiles), ``gw_of_st`` holds the window
+    # id per DENSE_B-tile group (length n_st // DENSE_B), and
+    # ``ow_of_st``/``first_of_ow`` are empty — a tile's grid position IS
+    # its (gw, ow), so the kernel emits per-tile partials and the ow
+    # reduction is a dense axis sum (no revisiting, no scatter);
+    # measured ~20% faster per tile than the revisiting kernel on v5e.
+    dense_grid: bool = struct.field(pytree_node=False, default=False)
     # Second-level plan over the heavy tail: under power-law skew the
     # groups that overflow ``cap`` can dwarf the kernel itself if left
     # to the XLA segment_sum fallback (measured 18 ms of a 23 ms
@@ -126,26 +140,46 @@ class GrrDirection:
     def n_spill(self) -> int:
         return int(self.spill_idx.shape[0])
 
+    @property
+    def n_ow_padded(self) -> int:
+        """Dense grid: padded ow count (n_supertiles / n_gw)."""
+        return self.n_supertiles // self.n_gw
+
     def contract(self, table: Array) -> Array:
         """``out[s] = Σ val_e · table[idx_e]`` for this plan — [n_segments]."""
         import os
 
         from photon_ml_tpu.ops.grr_kernel import (
             grr_contract_jnp,
+            grr_contract_jnp_dense,
             grr_contract_kernel,
+            grr_contract_kernel_dense,
         )
 
         pad = self.n_gw * WIN - self.table_len
         t = jnp.concatenate(
             [table.astype(jnp.float32), jnp.zeros((pad,), jnp.float32)]
         )
-        table_t = t.reshape(self.n_gw, TILE, TILE).transpose(0, 2, 1)
+        # Window rows ARE table sub-tiles (no transpose: the ETL keys
+        # start rows by (idx%WIN)//128 and gathers lanes by idx%128).
+        table_t = t.reshape(self.n_gw, TILE, TILE)
 
         use_kernel = (
             jax.default_backend() == "tpu"
             and os.environ.get("PHOTON_ML_TPU_GRR") != "0"
         )
-        if use_kernel:
+        if self.dense_grid:
+            if use_kernel:
+                out2d = grr_contract_kernel_dense(
+                    table_t, self.g1, self.g2, self.g3, self.vals,
+                    self.gw_of_st, n_ow_p=self.n_ow_padded, cap=self.cap,
+                )
+            else:
+                out2d = grr_contract_jnp_dense(
+                    table_t, self.g1, self.g2, self.g3, self.vals,
+                    n_ow_p=self.n_ow_padded, cap=self.cap,
+                )
+        elif use_kernel:
             out2d = grr_contract_kernel(
                 table_t, self.g1, self.g2, self.g3, self.vals,
                 self.gw_of_st, self.ow_of_st, self.first_of_ow,
@@ -175,6 +209,38 @@ class GrrDirection:
             overflow=(None if self.overflow is None
                       else self.overflow.squared()),
         )
+
+
+DENSE_GRID_MIN_FILL = 0.7
+
+
+def _maybe_dense_grid(G1, G2, G3, VALS, gw_of_st, ow_of_st, n_gw, n_ow,
+                      force=None):
+    """Reorder a built plan's tiles into the gw-major full (gw × ow_p)
+    grid (see ``GrrDirection.dense_grid``) when the block grid is dense
+    enough that the dummy tiles cost less than the revisiting kernel's
+    per-tile overhead.  Returns (G1, G2, G3, VALS, gwg) or None (keep
+    the legacy order)."""
+    from photon_ml_tpu.ops.grr_kernel import DENSE_B
+
+    n_ow_p = -(-n_ow // DENSE_B) * DENSE_B
+    n_st_p = n_gw * n_ow_p
+    n_st = VALS.shape[0]
+    dense = (force if force is not None
+             else n_st >= DENSE_GRID_MIN_FILL * n_st_p)
+    if not dense:
+        return None
+    pos = (np.asarray(gw_of_st, np.int64) * n_ow_p
+           + np.asarray(ow_of_st, np.int64))
+
+    def scatter(a):
+        out = np.zeros((n_st_p,) + a.shape[1:], a.dtype)
+        out[pos] = a
+        return out
+
+    gwg = np.repeat(np.arange(n_gw, dtype=np.int32), n_ow_p // DENSE_B)
+    return (scatter(np.asarray(G1)), scatter(np.asarray(G2)),
+            scatter(np.asarray(G3)), scatter(np.asarray(VALS)), gwg)
 
 
 def _spill_overflow(s_idx, s_seg, s_val, m_real, table_len, n_segments,
@@ -222,7 +288,8 @@ def _spill_overflow(s_idx, s_seg, s_val, m_real, table_len, n_segments,
 
 def _native_direction(cols, vals_masked, direction, table_len, n_segments,
                       cap, validate, overflow_threshold,
-                      device=True) -> "GrrDirection | None":
+                      device=True,
+                      dense_grid=None) -> "GrrDirection | None":
     """One direction's plan via the C++ builder (``pml_grr_plan``), or
     None when the native library is unavailable / declines the shape.
     Rank assignment differs from the numpy path (scan order vs sort
@@ -261,17 +328,26 @@ def _native_direction(cols, vals_masked, direction, table_len, n_segments,
             "consider a larger cap or a lower hot-column threshold",
             100 * m_coo / total, m_coo, total
         )
+    VALS, gw_arr = plan["vals"], plan["gw_of_st"]
+    ow_arr, first_arr = plan["ow_of_st"], plan["first_of_ow"]
+    dg = _maybe_dense_grid(G1, G2, G3, VALS, gw_arr, ow_arr,
+                           plan["n_gw"], plan["n_ow"], force=dense_grid)
+    is_dense = dg is not None
+    if is_dense:
+        G1, G2, G3, VALS, gw_arr = dg
+        ow_arr = first_arr = np.zeros(0, np.int32)
     return GrrDirection(
         g1=conv(G1), g2=conv(G2), g3=conv(G3),
-        vals=conv(plan["vals"]),
-        gw_of_st=conv(plan["gw_of_st"]),
-        ow_of_st=conv(plan["ow_of_st"]),
-        first_of_ow=conv(plan["first_of_ow"]),
+        vals=conv(VALS),
+        gw_of_st=conv(gw_arr),
+        ow_of_st=conv(ow_arr),
+        first_of_ow=conv(first_arr),
         spill_idx=conv(s_idx),
         spill_seg=conv(s_seg),
         spill_val=conv(s_val),
         table_len=table_len, n_segments=n_segments, cap=plan["cap"],
         n_gw=plan["n_gw"], n_ow=plan["n_ow"], overflow=overflow,
+        dense_grid=is_dense,
     )
 
 
@@ -285,6 +361,7 @@ def build_grr_direction(
     validate: bool = True,
     overflow_threshold: int | None = None,
     device: bool = True,
+    dense_grid: bool | None = None,
 ) -> GrrDirection:
     """Compile one direction's plan from COO (idx, seg, val).
 
@@ -356,13 +433,17 @@ def build_grr_direction(
 
     ow = seg // segwin
     bk = ow * n_gw + gw                    # block key, sorted order = (ow, gw)
-    rho = idx % TILE
+    # Start ROW = the entry's window sub-tile (idx%WIN)//128: the kernel
+    # then gathers straight from the UNtransposed table window (row s
+    # holds table[gw·WIN + s·128 ...]; the gather plane carries the lane
+    # residue idx%128).
+    hrow = (idx % WIN) // TILE
 
-    # Start-lane rank within (block, residue) among cap-kept entries;
-    # beyond 128 starts per residue → spill.
+    # Start-lane rank within (block, start-row) among cap-kept entries;
+    # beyond 128 starts per row → spill.
     k1 = ~spill1
     rank2 = np.full(idx.size, TILE, np.int64)
-    rank2[k1] = _group_ranks(bk[k1] * TILE + rho[k1])
+    rank2[k1] = _group_ranks(bk[k1] * TILE + hrow[k1])
     spill2 = k1 & (rank2 >= TILE)
     _mark("rank-rho")
     kept = k1 & ~spill2
@@ -392,7 +473,7 @@ def build_grr_direction(
     )
 
     # Start and final positions (within each supertile).
-    r_s = rho[kept]
+    r_s = hrow[kept]
     l_s = rank2[kept]
     b = (seg[kept] % segwin)
     r_f = q[kept] * group + b // TILE
@@ -401,7 +482,7 @@ def build_grr_direction(
     final_flat = st_of * SLOTS + r_f * TILE + l_f
 
     _mark("positions")
-    hi = ((idx[kept] % WIN) // TILE).astype(np.int8)
+    hi = (idx[kept] % TILE).astype(np.int8)
 
     HI = np.zeros(n_st * SLOTS, np.int8)
     HI[start_flat] = hi
@@ -482,6 +563,12 @@ def build_grr_direction(
         )
     _mark("spill")
     conv = jnp.asarray if device else np.asarray
+    dg = _maybe_dense_grid(G1, G2, G3, VALS, gw_of_st, ow_of_st,
+                           n_gw, n_ow, force=dense_grid)
+    is_dense = dg is not None
+    if is_dense:
+        G1, G2, G3, VALS, gw_of_st = dg
+        ow_of_st = first_of_ow = np.zeros(0, np.int32)
     return GrrDirection(
         g1=conv(G1), g2=conv(G2), g3=conv(G3),
         vals=conv(VALS),
@@ -492,6 +579,7 @@ def build_grr_direction(
         spill_val=conv(s_val),
         table_len=table_len, n_segments=n_segments, cap=cap,
         n_gw=n_gw, n_ow=n_ow, overflow=overflow,
+        dense_grid=is_dense,
     )
 
 
@@ -693,12 +781,12 @@ def build_grr_pair(
 
 def _build_direction_ell(cols, vals_masked, direction, table_len,
                          n_segments, cap, validate, overflow_threshold,
-                         device=True) -> GrrDirection:
+                         device=True, dense_grid=None) -> GrrDirection:
     """One direction straight from (hot-masked) ELL arrays: native C++
     builder first, numpy COO path as the fallback."""
     d = _native_direction(cols, vals_masked, direction, table_len,
                           n_segments, cap, validate, overflow_threshold,
-                          device=device)
+                          device=device, dense_grid=dense_grid)
     if d is not None:
         return d
     r_idx, k_idx = np.nonzero(vals_masked != 0)
@@ -710,6 +798,7 @@ def _build_direction_ell(cols, vals_masked, direction, table_len,
         idx=idx, seg=seg, val=v, table_len=table_len,
         n_segments=n_segments, cap=cap, validate=validate,
         overflow_threshold=overflow_threshold, device=device,
+        dense_grid=dense_grid,
     )
 
 
@@ -749,6 +838,11 @@ def _pad_grr_direction(d: GrrDirection, n_st: int, n_spill: int,
     accumulate-in-VMEM grid order stays valid."""
     rep = {}
     add = n_st - d.n_supertiles
+    if d.dense_grid and add:
+        raise AssertionError(
+            "dense-grid shard plans must have equal tile counts "
+            "(full grid); got a mismatch"
+        )
     if add:
         z3 = lambda a, dt: np.concatenate(
             [np.asarray(a), np.zeros((add,) + np.asarray(a).shape[1:], dt)])
@@ -790,6 +884,7 @@ def _pool_overflow(dirs: list, table_len: int, n_segments: int,
         return dirs
     order = sorted(range(len(dirs)), key=lambda i: -ms[i])
     l2cap = None
+    l2dense = None
     lvl2: list = [None] * len(dirs)
     for i in order:
         d = dirs[i]
@@ -799,9 +894,11 @@ def _pool_overflow(dirs: list, table_len: int, n_segments: int,
             val=np.asarray(d.spill_val),
             table_len=table_len, n_segments=n_segments, cap=l2cap,
             validate=validate, overflow_threshold=None, device=False,
+            dense_grid=l2dense,
         )
         if l2cap is None:
             l2cap = lvl2[i].cap
+            l2dense = lvl2[i].dense_grid
     if sum(x.n_supertiles for x in lvl2) * SLOTS > 96 * total:
         return dirs
     z = np.zeros(0, np.int32)
@@ -861,17 +958,20 @@ def build_sharded_grr_pairs(
 
     row_dirs, col_dirs, x_hots = [], [], []
     row_cap, col_cap = cap, cap
+    row_dense = col_dense = None   # forced to shard 0's auto choice
     for c, v in zip(shard_cols, shard_vals):
         c = np.asarray(c)
         v = np.asarray(v, np.float32)
         x_hot, keep = _apply_hot_split(c, v, dim, per, hot)
         vm = np.where(keep, v, np.float32(0.0))
         rd = _build_direction_ell(c, vm, 0, dim, per, row_cap, validate,
-                                  None, device=False)
+                                  None, device=False, dense_grid=row_dense)
         row_cap = row_cap or rd.cap
+        row_dense = rd.dense_grid if row_dense is None else row_dense
         cd_ = _build_direction_ell(c, vm, 1, per, dim, col_cap, validate,
-                                   None, device=False)
+                                   None, device=False, dense_grid=col_dense)
         col_cap = col_cap or cd_.cap
+        col_dense = cd_.dense_grid if col_dense is None else col_dense
         row_dirs.append(rd)
         col_dirs.append(cd_)
         x_hots.append(x_hot)

@@ -629,9 +629,16 @@ void grr_plan_body(GrrPlan* plan, const int32_t* cols, const float* vals,
       bool spilled = q >= cap;
       int64_t l_s = 0;
       const int64_t bk = (seg / segwin) * n_gw + gw;
-      const int64_t rho = idx % GRR_TILE;
+      // Start ROW = the entry's window sub-tile (idx%WIN)/128, so the
+      // kernel gathers from the UNtransposed table window: row s of the
+      // window holds table[gw*WIN + s*128 .. +127] and the gather plane
+      // carries the lane residue idx%128.  (Previously rows were keyed
+      // by residue, which required transposing every window per step —
+      // two ~100 us XLA transpose fusions per objective pass at bench
+      // shape.)
+      const int64_t hrow = (idx % GRR_WIN) / GRR_TILE;
       if (!spilled) {
-        uint16_t& r2 = r2cnt[bk * GRR_TILE + rho];
+        uint16_t& r2 = r2cnt[bk * GRR_TILE + hrow];
         l_s = r2;
         ++r2;
         spilled = l_s >= GRR_TILE;
@@ -644,12 +651,11 @@ void grr_plan_body(GrrPlan* plan, const int32_t* cols, const float* vals,
       }
       const int64_t st = st_of_bk[bk];
       const int64_t b = seg % segwin;
-      const int64_t s_start = rho * GRR_TILE + l_s;
+      const int64_t s_start = hrow * GRR_TILE + l_s;
       const int64_t s_final =
           (q * group + b / GRR_TILE) * GRR_TILE + (b % GRR_TILE);
       const int64_t base = st * GRR_SLOTS;
-      plan->hi[base + s_start] =
-          static_cast<int8_t>((idx % GRR_WIN) / GRR_TILE);
+      plan->hi[base + s_start] = static_cast<int8_t>(idx % GRR_TILE);
       plan->vals[base + s_final] = v;
       plan->dst[base + s_start] = static_cast<int32_t>(s_final);
       occ_s[(base + s_start) >> 6] |= (uint64_t{1} << (s_start & 63));

@@ -174,11 +174,12 @@ def test_kernel_interpret_matches_jnp(rng):
     )
 
     idx, seg, val = _coo(rng, 4000, 40000, 5000)
-    d = build_grr_direction(idx, seg, val, 40000, 5000, cap=8)
+    d = build_grr_direction(idx, seg, val, 40000, 5000, cap=8,
+                            dense_grid=False)
     table = jnp.asarray(rng.normal(0, 1, 40000).astype(np.float32))
     pad = d.n_gw * 16384 - d.table_len
     t = jnp.concatenate([table, jnp.zeros(pad, jnp.float32)])
-    table_t = t.reshape(d.n_gw, 128, 128).transpose(0, 2, 1)
+    table_t = t.reshape(d.n_gw, 128, 128)
     out_j = grr_contract_jnp(table_t, d.g1, d.g2, d.g3, d.vals,
                              d.gw_of_st, d.ow_of_st, n_ow=d.n_ow, cap=d.cap)
     out_k = grr_contract_kernel(table_t, d.g1, d.g2, d.g3, d.vals,
@@ -186,6 +187,43 @@ def test_kernel_interpret_matches_jnp(rng):
                                 n_ow=d.n_ow, cap=d.cap, interpret=True)
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_j),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_dense_kernel_interpret_matches_jnp(rng):
+    from photon_ml_tpu.ops.grr_kernel import (
+        grr_contract_jnp_dense,
+        grr_contract_kernel_dense,
+    )
+
+    idx, seg, val = _coo(rng, 40000, 40000, 5000)
+    d = build_grr_direction(idx, seg, val, 40000, 5000, cap=8,
+                            dense_grid=True)
+    assert d.dense_grid
+    table = jnp.asarray(rng.normal(0, 1, 40000).astype(np.float32))
+    pad = d.n_gw * 16384 - d.table_len
+    t = jnp.concatenate([table, jnp.zeros(pad, jnp.float32)])
+    table_t = t.reshape(d.n_gw, 128, 128)
+    out_j = grr_contract_jnp_dense(table_t, d.g1, d.g2, d.g3, d.vals,
+                                   n_ow_p=d.n_ow_padded, cap=d.cap)
+    out_k = grr_contract_kernel_dense(table_t, d.g1, d.g2, d.g3, d.vals,
+                                      d.gw_of_st, n_ow_p=d.n_ow_padded,
+                                      cap=d.cap, interpret=True)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_j),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dense_grid_matches_legacy_layout(rng):
+    """Same COO compiled both ways contracts identically."""
+    idx, seg, val = _coo(rng, 30000, 70000, 70000)
+    table = rng.normal(0, 1, 70000).astype(np.float32)
+    want = _direct(idx, seg, val, table, 70000)
+    for force in (True, False):
+        d = build_grr_direction(idx, seg, val, 70000, 70000,
+                                dense_grid=force)
+        assert d.dense_grid == force
+        np.testing.assert_allclose(
+            np.asarray(d.contract(jnp.asarray(table))), want,
+            rtol=2e-5, atol=2e-4)
 
 
 # -- crossbar router (advisor findings) --------------------------------------
