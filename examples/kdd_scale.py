@@ -97,6 +97,10 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--small", action="store_true",
                     help="10^5-example smoke run (CPU-friendly)")
+    ap.add_argument("--n", type=int, default=None,
+                    help="example count (default 10^7; ~2x10^7 is the "
+                         "largest class whose GRR plans fit one v5e's "
+                         "16 GB HBM — beyond that, shard over a mesh)")
     ap.add_argument("--out", default=None, help="also write the JSON here")
     args = ap.parse_args(argv)
 
@@ -104,6 +108,8 @@ def main(argv=None):
         n, d, k, ents = 100_000, 10_000, 10, 1_000
     else:
         n, d, k, ents = 10_000_000, 100_000, 10, 100_000
+    if args.n is not None:
+        n = args.n
 
     import tempfile
 

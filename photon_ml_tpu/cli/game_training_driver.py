@@ -124,31 +124,43 @@ def _save_result(result: FitResult, estimator: GameEstimator,
         "model_dir": model_dir,
         "reg_weights": result.reg_weights,
         "evaluations": {ev.value: v for ev, v in result.evaluations.items()},
+        # Per-CD-iteration validation trace (reference per-sweep
+        # evaluator logging); [] when trained without validation data.
+        "validation_history": [
+            {str(getattr(ev, "value", ev)): float(v)
+             for ev, v in entry.items()} if isinstance(entry, dict)
+            else float(entry)
+            for entry in result.validation_history
+        ],
     }
+
+
+def distributed_init_from_env() -> None:
+    """Join the JAX coordination service before first backend use
+    (multi-host scale-out, SURVEY §7 stage 9).  Coordinator address /
+    process count / index come from JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID (mapped here — JAX only
+    auto-detects managed clusters like TPU pods/SLURM).  Idempotent so
+    a caller-initialized process doesn't crash."""
+    import jax
+
+    if jax.distributed.is_initialized():
+        return
+    kw = {}
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        kw["coordinator_address"] = os.environ["JAX_COORDINATOR_ADDRESS"]
+    if os.environ.get("JAX_NUM_PROCESSES"):
+        kw["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+    if os.environ.get("JAX_PROCESS_ID"):
+        kw["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(**kw)
 
 
 def run(config: TrainingConfig, log: RunLogger | None = None) -> dict:
     """Full training pipeline; returns the written summary dict."""
     config.validate()
     if config.distributed_init:
-        # Multi-host scale-out (SURVEY §7 stage 9): join the JAX
-        # coordination service before first backend use.  Coordinator
-        # address/process count/index come from JAX_COORDINATOR_ADDRESS
-        # / JAX_NUM_PROCESSES / JAX_PROCESS_ID (mapped here — JAX only
-        # auto-detects managed clusters like TPU pods/SLURM).
-        # Idempotent guard so a caller-initialized process doesn't crash.
-        import jax
-
-        if not jax.distributed.is_initialized():
-            kw = {}
-            if os.environ.get("JAX_COORDINATOR_ADDRESS"):
-                kw["coordinator_address"] = \
-                    os.environ["JAX_COORDINATOR_ADDRESS"]
-            if os.environ.get("JAX_NUM_PROCESSES"):
-                kw["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
-            if os.environ.get("JAX_PROCESS_ID"):
-                kw["process_id"] = int(os.environ["JAX_PROCESS_ID"])
-            jax.distributed.initialize(**kw)
+        distributed_init_from_env()
     os.makedirs(config.output_dir, exist_ok=True)
     if log is None:
         log = RunLogger(os.path.join(config.output_dir, "run_log.jsonl"))

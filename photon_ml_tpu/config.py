@@ -45,6 +45,11 @@ class OptimizerSettings:
     reg_weight: float = 1.0
     elastic_net_alpha: float = 0.5  # only for ELASTIC_NET
     variance_type: VarianceComputationType = VarianceComputationType.NONE
+    # Record per-solver-iteration (value, ‖g‖) history (reference
+    # OptimizationStatesTracker, SURVEY §2.1/§5.5); the trace lands in
+    # the run log's cd_coordinate events.  Costs two [max_iters+1]
+    # arrays per solve.
+    track_states: bool = False
 
     def validate(self) -> None:
         if self.max_iters <= 0:
@@ -151,6 +156,11 @@ class TrainingConfig:
     resume: bool = False                   # resume from latest checkpoint
     intercept: bool = True
     seed: int = 0
+    # Score the validation set with every evaluator after each CD sweep
+    # (reference CoordinateDescent behavior, SURVEY §3.1); the trace
+    # lands in FitResult.validation_history + run-log cd_validation
+    # events.  Costs one validation transform per sweep.
+    validate_per_iteration: bool = True
     # Sparse fixed-effect batch layout: AUTO picks the GRR compiled plan
     # (data/grr.py — the fast TPU path) on TPU backends and plain ELL
     # elsewhere; GRR/COLMAJOR/ELL force a specific layout.

@@ -194,6 +194,7 @@ def make_sparse_batch(
     col_major: bool = False,
     col_capacity: int | None = None,
     grr: bool = False,
+    keep_ell: bool = True,
 ) -> SparseBatch:
     """Build a padded-ELL SparseBatch.
 
@@ -208,6 +209,12 @@ def make_sparse_batch(
         auto from the column-occupancy distribution).
       grr: compile the GRR plan (``data.grr``) — the fast TPU path for
         both contraction directions; supersedes ``col_major`` when set.
+      keep_ell: with ``grr``, whether the ELL arrays also go to device.
+        The GRR plan serves every contraction, so the device ELL copy
+        (8 bytes/nnz of HBM) is only needed by feature statistics /
+        normalization and the down-sampled training view; scale runs
+        that use neither pass False and the batch stores zero-width
+        [n, 0] placeholders instead (SURVEY §7 scale class).
     """
     from photon_ml_tpu.data.sparse_rows import SparseRows
 
@@ -257,6 +264,9 @@ def make_sparse_batch(
         else None
     )
     pair = build_grr_pair(cols, vals, dim) if grr else None
+    if grr and not keep_ell:
+        vals = np.zeros((n_out, 0), np.float32)
+        cols = np.zeros((n_out, 0), np.int32)
     return SparseBatch(
         values=jnp.asarray(vals, dtype),
         col_ids=jnp.asarray(cols),

@@ -746,12 +746,22 @@ def build_grr_pair(
     hot_threshold: int | None = None,
     max_hot: int = 128,
     validate: bool = True,
-    overflow_threshold: int = 16384,
+    overflow_threshold: int | None = None,
 ) -> GrrPair:
-    """Compile an ELL batch ([n,k] cols/vals) into the full GRR plan."""
+    """Compile an ELL batch ([n,k] cols/vals) into the full GRR plan.
+
+    ``overflow_threshold`` (spill entries below which the level-2 plan
+    is not worth building) defaults to nnz-scaled: a fixed 16k floor
+    plus 1/256 of the nonzeros, so 10⁸-nnz datasets don't compile a
+    multi-GB second level to absorb a relatively negligible tail
+    (SURVEY §7 scale class; the 96-slots-per-entry economy bound in
+    ``_spill_overflow`` still applies on top).
+    """
     cols = np.asarray(cols)
     vals = np.asarray(vals, np.float32)
     n, k = cols.shape
+    if overflow_threshold is None:
+        overflow_threshold = 16384 + int(np.count_nonzero(vals)) // 256
     if hot_threshold is None:
         # A column denser than ~48 entries per row-window will overflow
         # even the largest per-window capacity (64) and spill its whole
@@ -928,7 +938,7 @@ def build_sharded_grr_pairs(
     hot_threshold: int | None = None,
     max_hot: int = 128,
     validate: bool = True,
-    overflow_threshold: int = 16384,
+    overflow_threshold: int | None = None,
 ) -> list[GrrPair]:
     """Compile per-shard GRR plans over equal-size row shards.
 
@@ -941,6 +951,9 @@ def build_sharded_grr_pairs(
     n_shards = len(shard_cols)
     per = shard_cols[0].shape[0]
     n_total = per * n_shards
+    if overflow_threshold is None:   # nnz-scaled, as in build_grr_pair
+        nnz = sum(int(np.count_nonzero(np.asarray(v))) for v in shard_vals)
+        overflow_threshold = 16384 + nnz // 256
 
     # Global hot-column split: one hot id set for every shard.
     counts = np.zeros(dim, np.int64)

@@ -78,6 +78,9 @@ class FitResult:
     model: GameModel
     evaluations: dict            # EvaluatorType → float (validation)
     reg_weights: dict            # coordinate name → λ used
+    # Per-CD-iteration validation metrics (reference: CoordinateDescent
+    # logs every evaluator each sweep); empty without validation data.
+    validation_history: list = dataclasses.field(default_factory=list)
 
 
 def _reg_context(settings: OptimizerSettings, weight: float, dim: int,
@@ -100,7 +103,7 @@ def _optimizer_config(settings: OptimizerSettings) -> OptimizerConfig:
     return OptimizerConfig(
         max_iters=settings.max_iters,
         tolerance=settings.tolerance,
-        track_states=False,
+        track_states=settings.track_states,
     )
 
 
@@ -219,10 +222,18 @@ class GameEstimator:
 
                     layout = ("GRR" if jax.default_backend() == "tpu"
                               else "ELL")
+                # Device ELL is only consumed by normalization stats
+                # and the down-sampled view; a GRR batch that needs
+                # neither skips the 8-bytes/nnz HBM copy.
+                keep_ell = (
+                    cfg.normalization != NormalizationType.NONE
+                    or coord_cfg.down_sampling_rate is not None
+                )
                 batch = make_sparse_batch(
                     rows, dim, labels, weights=weights,
                     grr=(layout == "GRR"),
                     col_major=(layout == "COLMAJOR"),
+                    keep_ell=keep_ell,
                 )
 
         norm = NormalizationContext.identity()
@@ -476,6 +487,22 @@ class GameEstimator:
             intercept=self.config.intercept,
         )
 
+    def _model_snapshot(self, coords, coefficients: dict) -> GameModel:
+        """Current-coefficients model, no variances — the cheap export
+        used for per-iteration validation scoring."""
+        models = {}
+        by_name = {c.name: c for c in self.config.coordinates}
+        for name, w in coefficients.items():
+            coord_cfg = by_name[name]
+            coord = coords[name]
+            if coord_cfg.kind == CoordinateKind.FIXED_EFFECT:
+                models[name] = self._export_fixed(coord, w, coord_cfg, None)
+            else:
+                models[name] = coord.as_model(w)
+                models[name].feature_shard = coord_cfg.feature_shard
+                models[name].entity_key = coord_cfg.entity_key
+        return GameModel(models=models)
+
     def _to_game_model(self, coords, cd) -> GameModel:
         models = {}
         by_name = {c.name: c for c in self.config.coordinates}
@@ -547,10 +574,21 @@ class GameEstimator:
         ckpt_dir = cfg.checkpoint_dir
         if ckpt_dir and ckpt_tag:
             ckpt_dir = f"{ckpt_dir}/{ckpt_tag}"
+        validator = None
+        if validation is not None and cfg.validate_per_iteration:
+            # The reference's CoordinateDescent scores validation data
+            # and logs every evaluator each sweep (SURVEY §2.3/§3.1):
+            # snapshot the current coefficients into a (variance-free)
+            # model and evaluate it.
+            def validator(coefficients, _total_scores):
+                snap = self._model_snapshot(coords, coefficients)
+                return self._evaluate(snap, validation)
+
         cd = run_coordinate_descent(
             coordinates=coords,
             update_sequence=cfg.update_sequence,
             n_iterations=cfg.n_iterations,
+            validator=validator,
             locked_coordinates=locked,
             initial_coefficients=initial,
             checkpoint_dir=ckpt_dir,
@@ -558,13 +596,19 @@ class GameEstimator:
             run_logger=run_logger,
         )
         model = self._to_game_model(coords, cd)
-        evals = (self._evaluate(model, validation)
-                 if validation is not None else {})
+        if cd.validation_history:
+            # The last sweep's snapshot IS the final model (variances
+            # don't affect scoring) — no second validation pass needed.
+            evals = dict(cd.validation_history[-1])
+        else:
+            evals = (self._evaluate(model, validation)
+                     if validation is not None else {})
         return FitResult(
             model=model, evaluations=evals,
             reg_weights={c.name: reg_weights.get(
                 c.name, c.optimizer.reg_weight)
                 for c in cfg.coordinates},
+            validation_history=cd.validation_history,
         )
 
     def fit(self, train: GameDataset,

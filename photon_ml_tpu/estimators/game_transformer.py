@@ -44,15 +44,15 @@ def _score_fixed(model: FixedEffectModel, dataset: GameDataset) -> np.ndarray:
         if model.intercept:
             x = np.concatenate([x, np.ones((len(x), 1), np.float32)], 1)
         return np.asarray(jnp.asarray(x) @ jnp.asarray(w_np))
-    # Sparse rows: gather-dot per example; intercept is the last coef.
+    # Sparse rows: one vectorized gather + row-sum pass; intercept is
+    # the last coefficient.  (GameDataset normalizes legacy list rows
+    # to SparseRows at construction, so this is the only sparse path.)
     base = w_np[-1] if model.intercept else 0.0
     from photon_ml_tpu.data.sparse_rows import SparseRows
 
-    if isinstance(feats, SparseRows):
-        return feats.dot_dense(w_np.astype(np.float64)) + np.float32(base)
-    return np.asarray(
-        [float(v @ w_np[c]) + base for c, v in feats], np.float32
-    )
+    rows = feats if isinstance(feats, SparseRows) else \
+        SparseRows.from_rows(feats)
+    return rows.dot_dense(w_np.astype(np.float64)) + np.float32(base)
 
 
 def _score_random(model: RandomEffectModel, entity_ids: np.ndarray,

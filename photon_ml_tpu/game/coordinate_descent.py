@@ -28,6 +28,7 @@ import logging
 import time
 
 import jax.numpy as jnp
+import numpy as np
 
 from photon_ml_tpu.game.coordinates import Coordinate
 
@@ -39,12 +40,26 @@ def _diag_fields(diag) -> dict:
     (an ``OptimizationResult`` for fixed effects; a per-bucket list of
     batched results for random effects)."""
     if hasattr(diag, "value") and jnp.ndim(diag.value) == 0:
-        return {
+        out = {
             "value": float(diag.value),
             "grad_norm": float(diag.grad_norm),
             "solver_iterations": int(diag.iterations),
             "converged": bool(diag.converged),
         }
+        tracker = getattr(diag, "tracker", None)
+        if tracker is not None and int(tracker.count) > 0:
+            # Per-solver-iteration convergence trace (reference
+            # OptimizationStatesTracker; slot 0 = initial point).
+            # Bulk device→host copies, not one sync per element.
+            c = int(tracker.count)
+            out["states"] = {
+                "values": np.round(
+                    np.asarray(tracker.values[:c], np.float64), 8).tolist(),
+                "grad_norms": np.round(
+                    np.asarray(tracker.grad_norms[:c], np.float64),
+                    8).tolist(),
+            }
+        return out
     if isinstance(diag, (list, tuple)) and diag and hasattr(diag[0], "value"):
         # Batched per-entity results: aggregate convergence stats.
         n = sum(int(r.value.shape[0]) for r in diag)
@@ -85,8 +100,15 @@ def run_coordinate_descent(
         ``updateSequence`` param).
       n_iterations: full sweeps over the sequence (reference
         ``coordinateDescentIterations``).
-      validator: optional callable ``(total_scores) → float`` run once
-        per iteration (the reference's per-iteration validation).
+      validator: optional callable ``(coefficients: dict, total_scores)
+        → float | dict`` run once per full sweep (the reference's
+        per-iteration validation: CoordinateDescent scores the
+        validation set and logs every evaluator each iteration, SURVEY
+        §2.3/§3.1).  ``coefficients`` are the current per-coordinate
+        values (for scoring held-out data); ``total_scores`` the
+        current train-set score sum (for cheap train-side metrics).
+        A dict return (evaluator → value) is recorded as-is in
+        ``validation_history`` and the run log.
       locked_coordinates: name → pre-trained coefficients for partial
         retraining (reference ``partialRetrainLockedCoordinates``):
         locked coordinates contribute scores but are never retrained.
@@ -165,7 +187,11 @@ def run_coordinate_descent(
             offsets = total - scores[name]
             # The warm-start buffer is rebound to the result right
             # below, so let XLA write the new coefficients into the old
-            # buffer (donation; SURVEY §5.2).
+            # buffer (donation; SURVEY §5.2).  NOTE: on the first sweep
+            # this consumes the caller's initial_coefficients /
+            # checkpoint-restored arrays — any later read of those
+            # buffers would hit a deleted-buffer error; nothing in this
+            # loop re-reads them (coefs[name] is rebound below).
             w, diag = coord.train(offsets, coefs.get(name),
                                   donate_warm_start=True)
             new_scores = coord.score(w)
@@ -185,13 +211,17 @@ def run_coordinate_descent(
                 )
         history.append(iter_diag)
         if validator is not None:
-            metric = validator(total)
+            metric = validator(coefs, total)
             validation_history.append(metric)
-            logger.info("CD iter %d validation metric %.6f", it + 1,
-                        float(metric))
+            if isinstance(metric, dict):
+                fields = {str(getattr(k, "value", k)): float(v)
+                          for k, v in metric.items()}
+            else:
+                fields = {"metric": float(metric)}
+            logger.info("CD iter %d validation %s", it + 1, fields)
             if run_logger is not None:
                 run_logger.event("cd_validation", iteration=it + 1,
-                                 metric=float(metric))
+                                 **fields)
         if checkpoint_dir is not None:
             from photon_ml_tpu.utils.checkpoint import save_checkpoint
 

@@ -75,8 +75,16 @@ class EntityGrouping:
     def join_ids(self, query_ids: np.ndarray) -> np.ndarray:
         """id → global entity index (into ``entity_ids``), −1 for
         unseen — the reference's RDD join as one vectorized
-        searchsorted (``entity_ids`` is np.unique output = sorted)."""
-        return sorted_id_join(np.asarray(self.entity_ids), query_ids)
+        searchsorted.  ``entity_ids`` must be strictly ascending
+        (np.unique output; preserved by model I/O) — checked here
+        because a grouping deserialized by any other path would
+        otherwise misjoin silently (advisor finding)."""
+        ids = np.asarray(self.entity_ids)
+        if ids.size > 1 and not bool((np.diff(ids) > 0).all()):
+            raise ValueError(
+                "EntityGrouping.entity_ids must be strictly ascending "
+                "and unique for join_ids (np.unique order)")
+        return sorted_id_join(ids, query_ids)
 
     def entity_row_map(self) -> np.ndarray:
         """Dense (bucket, slot) → global entity index map
@@ -243,6 +251,18 @@ class GameDataset:
     # Widths of sparse shards (dense shards infer from the array).
     feature_dims: dict = dataclasses.field(default_factory=dict)
 
+    def __post_init__(self):
+        # Normalize legacy ``list[(col_ids, values)]`` shards to
+        # ``SparseRows`` ONCE at construction: every downstream consumer
+        # (batch build, grouping, projection, transformer scoring) then
+        # takes the vectorized flat-array path — no per-example Python
+        # on any production loop (SURVEY §7 scale doctrine).
+        from photon_ml_tpu.data.sparse_rows import SparseRows
+
+        for s, f in self.features.items():
+            if not isinstance(f, (np.ndarray, SparseRows)):
+                self.features[s] = SparseRows.from_rows(f)
+
     @property
     def n(self) -> int:
         return len(self.labels)
@@ -264,15 +284,32 @@ class GameDataset:
         return (np.ones(self.n, np.float32) if self.weights is None
                 else self.weights.astype(np.float32))
 
-    def take(self, idx: np.ndarray) -> "GameDataset":
-        """Row subset (train/validation splits in the drivers)."""
+    def take(self, idx) -> "GameDataset":
+        """Row subset (train/validation splits in the drivers).
+
+        A ``slice`` — or an index array that is a contiguous ascending
+        range, the shape every train/valid split produces — subsets by
+        numpy basic slicing, i.e. zero-copy VIEWS of every array
+        (SURVEY §7 scale class: splitting a 10⁸-example dataset must
+        not triple host RSS).  Arbitrary index arrays still copy.
+        """
         from photon_ml_tpu.data.sparse_rows import SparseRows
 
+        if not isinstance(idx, slice):
+            idx = np.asarray(idx)
+            if idx.dtype == bool:
+                idx = np.flatnonzero(idx)
+            idx = idx.astype(np.int64)
+            if idx.size and idx[0] >= 0 and bool(
+                (np.diff(idx) == 1).all() if idx.size > 1 else True
+            ):
+                idx = slice(int(idx[0]), int(idx[-1]) + 1)
+
         def sub(feats):
-            if isinstance(feats, np.ndarray):
+            if isinstance(feats, (np.ndarray, SparseRows)):
                 return feats[idx]
-            if isinstance(feats, SparseRows):
-                return feats.take(idx)
+            if isinstance(idx, slice):
+                return feats[idx]
             return [feats[int(i)] for i in idx]
 
         return GameDataset(

