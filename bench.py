@@ -80,6 +80,8 @@ def _grr_stream_bytes(pair) -> int:
         return b
 
     total = direction_bytes(pair.row_dir) + direction_bytes(pair.col_dir)
+    if pair.col_mid is not None:
+        total += direction_bytes(pair.col_mid)
     total += int(np.prod(pair.x_hot.shape)) * 4 * 2   # dense side, 2 dirs
     return total
 

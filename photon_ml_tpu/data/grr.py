@@ -659,12 +659,28 @@ class GrrPair:
     The complete TPU-fast replacement for a sparse design matrix:
     ``dot``/``t_dot`` are X·v and Xᵀ·r with margins/gradients running
     through the GRR kernel and hot columns through one MXU matmul.
+
+    Under power-law column popularity three column classes get three
+    structures (the scale lesson — a 10⁸-nnz CTR dataset broke both
+    extremes): MEGA-hot columns (denser than any per-window capacity)
+    go to the dense [n, H] MXU side, but H is byte-budgeted — at 10⁷⁺
+    rows each dense column costs 4n bytes of HBM; MID-hot columns
+    (would overflow the tail plan's capacity everywhere, yet are far
+    too sparse to afford densifying) get their own compact GRR plan
+    ``col_mid`` over remapped ids [0, M) — restricting segments to just
+    those M columns collapses the plan to ~1 segment-window, so a high
+    cap fits them at a few slots/entry; the TAIL runs the main plan +
+    level-2 overflow.  Only the gradient direction needs the mid split
+    (segments = columns there); the row direction absorbs mid entries
+    in its ordinary row groups.
     """
 
     row_dir: GrrDirection     # segments = rows, table = w-space
-    col_dir: GrrDirection     # segments = cols, table = residual-space
+    col_dir: GrrDirection     # segments = TAIL cols, table = residual-space
     hot_ids: Array            # [H] i32
     x_hot: Array              # [n_rows, H] f32
+    mid_ids: Array | None = None       # [M] i32 — mid-hot column ids
+    col_mid: "GrrDirection | None" = None  # segments = mid cols (compact)
 
     @property
     def n_rows(self) -> int:
@@ -688,6 +704,8 @@ class GrrPair:
             col_dir=self.col_dir.squared(),
             hot_ids=self.hot_ids,
             x_hot=self.x_hot * self.x_hot,
+            mid_ids=self.mid_ids,
+            col_mid=None if self.col_mid is None else self.col_mid.squared(),
         )
 
 
@@ -700,6 +718,8 @@ def _dot_impl(pair: GrrPair, w: Array) -> Array:
 
 def _tdot_impl(pair: GrrPair, r: Array) -> Array:
     out = pair.col_dir.contract(r)
+    if pair.col_mid is not None:
+        out = out.at[pair.mid_ids].add(pair.col_mid.contract(r))
     if pair.hot_ids.shape[0]:
         out = out.at[pair.hot_ids].add(pair.x_hot.T @ r)
     return out
@@ -738,6 +758,38 @@ def _grr_tdot(pair: GrrPair, r: Array) -> Array:
     return f(r)
 
 
+def _mid_hot_split(cols, vals_masked, dim, n, mid_threshold, validate,
+                   overflow_threshold, device=True, mid=None, cap=None,
+                   dense_grid=None):
+    """Mid-hot column split for the gradient direction (see GrrPair
+    docstring): columns whose per-row-window occupancy would overflow
+    the tail plan's capacities get a compact GrrDirection over remapped
+    ids.  ``mid``/``cap``/``dense_grid`` may be forced (the sharded
+    build needs one global mid set and mesh-uniform plan structure).
+    Returns (mid_ids [M] i32 | None, col_mid | None, vals_masked_tail).
+    """
+    nz = vals_masked != 0
+    if mid is None:
+        counts = np.bincount(cols[nz].reshape(-1), minlength=dim)
+        mid = np.flatnonzero(counts > mid_threshold)
+    if not mid.size:
+        return None, None, vals_masked
+    pos = np.full(dim, -1, np.int64)
+    pos[mid] = np.arange(mid.size)
+    is_mid = nz & (pos[cols] >= 0)
+    r_idx, k_idx = np.nonzero(is_mid)
+    col_mid = build_grr_direction(
+        idx=r_idx.astype(np.int64),
+        seg=pos[cols[r_idx, k_idx]],
+        val=vals_masked[r_idx, k_idx],
+        table_len=n, n_segments=int(mid.size), cap=cap,
+        validate=validate, overflow_threshold=overflow_threshold,
+        device=device, dense_grid=dense_grid,
+    )
+    tail = np.where(is_mid, np.float32(0.0), vals_masked)
+    return mid.astype(np.int32), col_mid, tail
+
+
 def build_grr_pair(
     cols: np.ndarray,
     vals: np.ndarray,
@@ -745,6 +797,8 @@ def build_grr_pair(
     cap: int | None = None,
     hot_threshold: int | None = None,
     max_hot: int = 128,
+    max_hot_bytes: int = 2 << 30,
+    mid_threshold: int | None = None,
     validate: bool = True,
     overflow_threshold: int | None = None,
 ) -> GrrPair:
@@ -755,37 +809,51 @@ def build_grr_pair(
     plus 1/256 of the nonzeros, so 10⁸-nnz datasets don't compile a
     multi-GB second level to absorb a relatively negligible tail
     (SURVEY §7 scale class; the 96-slots-per-entry economy bound in
-    ``_spill_overflow`` still applies on top).
+    ``_spill_overflow`` still applies on top).  ``max_hot_bytes``
+    bounds the dense hot side's HBM cost (each dense column is 4n
+    bytes); ``mid_threshold`` (default 16 entries per row-window)
+    routes columns too dense for the tail plan but below the dense
+    cutoff to the compact ``col_mid`` plan.
     """
     cols = np.asarray(cols)
     vals = np.asarray(vals, np.float32)
     n, k = cols.shape
     if overflow_threshold is None:
         overflow_threshold = 16384 + int(np.count_nonzero(vals)) // 256
+    n_row_windows = max(1, -(-n // WIN))
     if hot_threshold is None:
         # A column denser than ~48 entries per row-window will overflow
         # even the largest per-window capacity (64) and spill its whole
         # mass; route such columns to the dense MXU side.  (For small n
         # this sweeps most columns dense — which is exactly right:
         # small-d problems ARE dense matmuls.)
-        n_row_windows = max(1, -(-n // WIN))
         hot_threshold = min(max(64, n // 16), 48 * n_row_windows)
+    max_hot = min(max_hot, max(1, max_hot_bytes // (4 * n)))
     hot_ids, x_hot, keep = dense_hot_split(
         cols, vals, dim, n, threshold=hot_threshold, max_hot=max_hot
     )
+    vals_masked = np.where(keep, vals, np.float32(0.0))
+    if mid_threshold is None:
+        mid_threshold = 16 * n_row_windows
+    mid_ids, col_mid, vals_tail = _mid_hot_split(
+        cols, vals_masked, dim, n, mid_threshold, validate,
+        overflow_threshold)
     # Fast path: the native C++ builder consumes the ELL arrays
     # directly (hot entries zeroed = dropped), streaming passes with
     # cache-local counters instead of numpy full-array sorts.  Each
     # direction falls back independently (the directions are built
-    # independently either way).
-    vals_masked = np.where(keep, vals, np.float32(0.0))
+    # independently either way).  The row direction keeps mid entries
+    # (rows group them like any others); only the gradient direction
+    # excludes them.
     row_dir = _build_direction_ell(cols, vals_masked, 0, dim, n, cap,
                                    validate, overflow_threshold)
-    col_dir = _build_direction_ell(cols, vals_masked, 1, n, dim, cap,
+    col_dir = _build_direction_ell(cols, vals_tail, 1, n, dim, cap,
                                    validate, overflow_threshold)
     return GrrPair(
         row_dir=row_dir, col_dir=col_dir,
         hot_ids=jnp.asarray(hot_ids), x_hot=jnp.asarray(x_hot),
+        mid_ids=None if mid_ids is None else jnp.asarray(mid_ids),
+        col_mid=col_mid,
     )
 
 
@@ -937,6 +1005,8 @@ def build_sharded_grr_pairs(
     cap: int | None = None,
     hot_threshold: int | None = None,
     max_hot: int = 128,
+    max_hot_bytes: int = 2 << 30,
+    mid_threshold: int | None = None,
     validate: bool = True,
     overflow_threshold: int | None = None,
 ) -> list[GrrPair]:
@@ -961,28 +1031,73 @@ def build_sharded_grr_pairs(
         nz = np.asarray(v) != 0
         counts += np.bincount(
             np.asarray(c)[nz].reshape(-1), minlength=dim)
+    n_row_windows = max(1, -(-per // WIN)) * n_shards
     if hot_threshold is None:
         # Same economics as build_grr_pair, scaled to the shard-local
         # col_dir window count (a column overflows per-shard windows).
-        n_row_windows = max(1, -(-per // WIN)) * n_shards
         hot_threshold = min(max(64, n_total // 16), 48 * n_row_windows)
+    # Byte budget applies to each DEVICE's x_hot shard [per, H].
+    max_hot = min(max_hot, max(1, max_hot_bytes // (4 * per)))
     hot = _select_hot(counts, hot_threshold, max_hot)
     hot_ids = hot.astype(np.int32)
 
-    row_dirs, col_dirs, x_hots = [], [], []
-    row_cap, col_cap = cap, cap
-    row_dense = col_dense = None   # forced to shard 0's auto choice
+    # Global mid-hot set (GrrPair docstring): forced common across
+    # shards so the pytrees stay congruent.
+    if mid_threshold is None:
+        mid_threshold = 16 * n_row_windows
+    counts_nonhot = counts.copy()
+    counts_nonhot[hot] = 0
+    mid = np.flatnonzero(counts_nonhot > mid_threshold)
+    mid_ids = mid.astype(np.int32) if mid.size else None
+    mid_pos = None
+    if mid.size:
+        mid_pos = np.full(dim, -1, np.int64)
+        mid_pos[mid] = np.arange(mid.size)
+
+    # Pass 1: hot/mid masking per shard (+ per-shard mid mass, so the
+    # mid cap is seeded by a shard that actually CARRIES mid entries —
+    # the global mid set can be concentrated in a few shards, and an
+    # empty shard's heuristic cap would doom the others to spill).
+    prepped, mid_counts = [], []
     for c, v in zip(shard_cols, shard_vals):
         c = np.asarray(c)
         v = np.asarray(v, np.float32)
         x_hot, keep = _apply_hot_split(c, v, dim, per, hot)
         vm = np.where(keep, v, np.float32(0.0))
+        prepped.append((c, x_hot, vm))
+        mid_counts.append(
+            0 if mid_pos is None
+            else int(((vm != 0) & (mid_pos[c] >= 0)).sum()))
+
+    # Pass 2: mid plans, heaviest shard first (cap/dense seeding).
+    mid_dirs: list = [None] * n_shards
+    tails: list = [None] * n_shards
+    m_cap = m_dense = None
+    if mid_pos is not None:
+        for i in sorted(range(n_shards), key=lambda j: -mid_counts[j]):
+            c, _, vm = prepped[i]
+            _, md, tail = _mid_hot_split(
+                c, vm, dim, per, mid_threshold, validate, None,
+                device=False, mid=mid, cap=m_cap, dense_grid=m_dense,
+            )
+            m_cap = m_cap or md.cap
+            m_dense = md.dense_grid if m_dense is None else m_dense
+            mid_dirs[i] = md
+            tails[i] = tail
+
+    # Pass 3: main directions per shard.
+    row_dirs, col_dirs, x_hots = [], [], []
+    row_cap, col_cap = cap, cap
+    row_dense = col_dense = None   # forced to shard 0's auto choice
+    for i, (c, x_hot, vm) in enumerate(prepped):
+        vm_tail = tails[i] if tails[i] is not None else vm
         rd = _build_direction_ell(c, vm, 0, dim, per, row_cap, validate,
                                   None, device=False, dense_grid=row_dense)
         row_cap = row_cap or rd.cap
         row_dense = rd.dense_grid if row_dense is None else row_dense
-        cd_ = _build_direction_ell(c, vm, 1, per, dim, col_cap, validate,
-                                   None, device=False, dense_grid=col_dense)
+        cd_ = _build_direction_ell(c, vm_tail, 1, per, dim, col_cap,
+                                   validate, None, device=False,
+                                   dense_grid=col_dense)
         col_cap = col_cap or cd_.cap
         col_dense = cd_.dense_grid if col_dense is None else col_dense
         row_dirs.append(rd)
@@ -995,8 +1110,14 @@ def build_sharded_grr_pairs(
                               overflow_threshold)
     row_dirs = _pad_dirs_common(row_dirs)
     col_dirs = _pad_dirs_common(col_dirs)
+    if mid_pos is not None:
+        mid_dirs = _pool_overflow(mid_dirs, per, int(mid.size), validate,
+                                  overflow_threshold)
+        mid_dirs = _pad_dirs_common(mid_dirs)
     return [
         GrrPair(row_dir=rd, col_dir=cd_, hot_ids=hot_ids.copy(),
-                x_hot=xh)
-        for rd, cd_, xh in zip(row_dirs, col_dirs, x_hots)
+                x_hot=xh,
+                mid_ids=None if mid_ids is None else mid_ids.copy(),
+                col_mid=md)
+        for rd, cd_, xh, md in zip(row_dirs, col_dirs, x_hots, mid_dirs)
     ]

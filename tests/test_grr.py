@@ -406,3 +406,60 @@ def test_overflow_level_absorbs_spill(rng):
         np.asarray(two_level.squared().t_dot(jnp.abs(r))),
         np.asarray(plain.squared().t_dot(jnp.abs(r))),
         rtol=2e-4, atol=2e-4)
+
+
+def test_mid_hot_columns_split(rng):
+    """Power-law columns: mega-hot → dense side, mid-hot → compact
+    col_mid plan, tail → main plan; contraction exact throughout."""
+    n, k, dim = 4096, 8, 2000
+    # ~6 mega-hot columns (0..5 in most rows), a band of mid-hot
+    # columns (6..29 frequently), and a uniform tail.
+    cols = np.zeros((n, k), np.int64)
+    cols[:, 0] = rng.integers(0, 6, n)                  # mega-hot
+    cols[:, 1] = rng.integers(6, 30, n)                 # mid-hot band
+    cols[:, 2:] = rng.integers(30, dim, (n, k - 2))
+    # de-duplicate per row (resample collisions into distinct slots)
+    for j in range(1, k):
+        for _ in range(6):
+            dup = (cols[:, j:j + 1] == cols[:, :j]).any(axis=1)
+            if not dup.any():
+                break
+            lo = 6 if j == 1 else 30
+            cols[dup, j] = rng.integers(lo, dim, int(dup.sum()))
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    pair = build_grr_pair(cols.astype(np.int32), vals, dim,
+                          hot_threshold=500, mid_threshold=40)
+    assert pair.hot_ids.shape[0] > 0          # mega-hot split happened
+    assert pair.col_mid is not None           # mid plan exists
+    assert pair.mid_ids.shape[0] > 0
+
+    x = np.zeros((n, dim), np.float64)
+    np.add.at(x, (np.repeat(np.arange(n), k), cols.reshape(-1)),
+              vals.reshape(-1).astype(np.float64))
+    w = rng.normal(0, 1, dim).astype(np.float32)
+    r = rng.normal(0, 1, n).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pair.dot(jnp.asarray(w))),
+                               x @ w, rtol=2e-5, atol=3e-4)
+    np.testing.assert_allclose(np.asarray(pair.t_dot(jnp.asarray(r))),
+                               x.T @ r, rtol=2e-5, atol=3e-4)
+    np.testing.assert_allclose(
+        np.asarray(pair.squared().t_dot(jnp.asarray(r))),
+        (x * x).T @ r, rtol=2e-5, atol=3e-4)
+
+
+def test_max_hot_bytes_budget(rng):
+    """The dense hot side respects its HBM byte budget."""
+    n, k, dim = 2048, 4, 64
+    cols = np.stack([rng.choice(dim, k, replace=False)
+                     for _ in range(n)]).astype(np.int32)
+    vals = np.ones((n, k), np.float32)
+    # Without a budget nearly every column densifies (small-d regime);
+    # with a tight budget H collapses to the allowance.
+    free = build_grr_pair(cols, vals, dim)
+    tight = build_grr_pair(cols, vals, dim, max_hot_bytes=4 * n * 3)
+    assert free.hot_ids.shape[0] > 3
+    assert tight.hot_ids.shape[0] <= 3
+    w = rng.normal(0, 1, dim).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(tight.dot(jnp.asarray(w))),
+                               np.asarray(free.dot(jnp.asarray(w))),
+                               rtol=2e-5, atol=3e-4)
