@@ -833,7 +833,8 @@ def build_grr_pair(
         cols, vals, dim, n, threshold=hot_threshold, max_hot=max_hot
     )
     vals_masked = np.where(keep, vals, np.float32(0.0))
-    if mid_threshold is None:
+    auto_mid = mid_threshold is None
+    if auto_mid:
         mid_threshold = 16 * n_row_windows
     # Fast path: the native C++ builder consumes the ELL arrays
     # directly (hot entries zeroed = dropped), streaming passes with
@@ -849,9 +850,17 @@ def build_grr_pair(
     from concurrent.futures import ThreadPoolExecutor
 
     def col_chain():
-        mid_ids, col_mid, vals_tail = _mid_hot_split(
-            cols, vals_masked, dim, n, mid_threshold, validate,
-            overflow_threshold)
+        # The auto heuristic skips the mid split below one full row
+        # window: the compact plan's start-lane capacity (n starts per
+        # block) is smaller than the mid mass it would carry, and tiny
+        # batches belong to the dense/hot side anyway.  An explicit
+        # mid_threshold overrides (tests, tuned workloads).
+        if not auto_mid or n >= WIN:
+            mid_ids, col_mid, vals_tail = _mid_hot_split(
+                cols, vals_masked, dim, n, mid_threshold, validate,
+                overflow_threshold)
+        else:
+            mid_ids, col_mid, vals_tail = None, None, vals_masked
         col_dir = _build_direction_ell(cols, vals_tail, 1, n, dim, cap,
                                        validate, overflow_threshold)
         return mid_ids, col_mid, col_dir
@@ -1056,11 +1065,16 @@ def build_sharded_grr_pairs(
 
     # Global mid-hot set (GrrPair docstring): forced common across
     # shards so the pytrees stay congruent.
-    if mid_threshold is None:
+    auto_mid = mid_threshold is None
+    if auto_mid:
         mid_threshold = 16 * n_row_windows
     counts_nonhot = counts.copy()
     counts_nonhot[hot] = 0
-    mid = np.flatnonzero(counts_nonhot > mid_threshold)
+    # Same one-full-row-window guard as build_grr_pair (start-lane
+    # capacity of the compact plan scales with shard rows); explicit
+    # mid_threshold overrides.
+    mid = (np.flatnonzero(counts_nonhot > mid_threshold)
+           if (not auto_mid or per >= WIN) else np.zeros(0, np.int64))
     mid_ids = mid.astype(np.int32) if mid.size else None
     mid_pos = None
     if mid.size:
