@@ -463,3 +463,55 @@ def test_max_hot_bytes_budget(rng):
     np.testing.assert_allclose(np.asarray(tight.dot(jnp.asarray(w))),
                                np.asarray(free.dot(jnp.asarray(w))),
                                rtol=2e-5, atol=3e-4)
+
+
+def test_overflow_chain_recurses(rng):
+    """Power-law tails absorb through MULTIPLE overflow levels: the
+    chain leaves less COO residual than a single level, and the
+    contraction stays exact."""
+    nnz, L, S = 120_000, 3000, 3000
+    # Zipf-ish segments: heavy repeat groups spanning several levels.
+    seg = (S * rng.random(nnz) ** 3.0).astype(np.int64)
+    idx = rng.integers(0, L, nnz)
+    val = rng.normal(0, 1, nnz).astype(np.float32)
+    chain = build_grr_direction(idx, seg, val, L, S, cap=4,
+                                overflow_threshold=500)
+    shallow = build_grr_direction(idx, seg, val, L, S, cap=4,
+                                  overflow_threshold=500,
+                                  overflow_depth=1)
+
+    def walk(d):
+        depth, residual = 0, 0
+        while d is not None:
+            residual = int(np.count_nonzero(np.asarray(d.spill_val)))
+            depth += 1
+            d = d.overflow
+        return depth, residual
+
+    depth, residual = walk(chain)
+    depth1, residual1 = walk(shallow)
+    assert depth >= 3          # lvl1 + at least two overflow levels
+    assert depth1 == 2
+    assert residual < residual1   # deeper chain absorbs more
+    table = rng.normal(0, 1, L).astype(np.float32)
+    for d in (chain, shallow):
+        np.testing.assert_allclose(
+            np.asarray(d.contract(jnp.asarray(table))),
+            _direct(idx, seg, val, table, S), rtol=2e-5, atol=5e-4)
+
+
+def test_overflow_chain_depth_capped(rng):
+    """A single mega-segment (each level absorbs only ~cap·n_gw
+    entries) must terminate at the depth cap, not recurse unboundedly
+    (review-confirmed RecursionError without the cap)."""
+    nnz, L, S = 300_000, 100_000, 3000
+    idx = rng.integers(0, L, nnz)
+    seg = np.zeros(nnz, np.int64)
+    val = rng.normal(0, 1, nnz).astype(np.float32)
+    d = build_grr_direction(idx, seg, val, L, S, cap=4,
+                            overflow_threshold=500)
+    depth = 0
+    while d is not None:
+        depth += 1
+        d = d.overflow
+    assert depth <= 5          # lvl1 + at most overflow_depth=4 levels
