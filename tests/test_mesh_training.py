@@ -183,3 +183,16 @@ def test_entity_blocks_balanced_on_mesh(rng):
         assert s.shape[0] % 8 == 0
         assert isinstance(s.sharding, NamedSharding)
         assert s.sharding.spec[0] == ENTITY_AXIS
+
+
+def test_mesh_grr_with_validation_and_traces(rng):
+    """Round-4 composition: sharded GRR layout + per-sweep validation +
+    solver state traces, one fit."""
+    ds, _ = _sparse_dataset(rng, n=400)
+    cfg = _fixed_cfg(n_devices=8, sparse_layout="GRR", n_iterations=2)
+    cfg.coordinates[0].optimizer.track_states = True
+    r = GameEstimator(cfg).fit(ds, ds)[0]
+    assert len(r.validation_history) == 2
+    assert all(EvaluatorType.AUC in h for h in r.validation_history)
+    assert r.evaluations == r.validation_history[-1]
+    assert r.evaluations[EvaluatorType.AUC] > 0.8
