@@ -70,6 +70,36 @@ def _diag_fields(diag) -> dict:
     return {}
 
 
+def _call_validator(validator, coefs, total):
+    """Call a per-sweep validator, accepting both the current two-arg
+    ``(coefficients, total_scores)`` signature and the pre-round-4
+    one-arg ``(total_scores)`` form (advisor finding: the signature
+    changed with no shim, so an external caller's old validator would
+    TypeError mid-training).  Arity is inspected up front — catching
+    TypeError around the call would mask genuine TypeErrors raised
+    *inside* the validator.  The rule is REQUIRED positional count: a
+    validator with exactly one required positional parameter is treated
+    as legacy even if it carries optional extras (a legacy
+    ``(total_scores, sample_weight=None)`` must not get coefficients
+    bound to its scores argument); new-style validators should require
+    both parameters."""
+    import inspect
+
+    try:
+        params = list(inspect.signature(validator).parameters.values())
+    except (TypeError, ValueError):  # builtins / C callables: assume new
+        return validator(coefs, total)
+    required = [
+        p for p in params
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+        and p.default is p.empty
+    ]
+    var_pos = any(p.kind is p.VAR_POSITIONAL for p in params)
+    if len(required) == 1 and not var_pos:
+        return validator(total)
+    return validator(coefs, total)
+
+
 @dataclasses.dataclass
 class CoordinateDescentResult:
     """Trained coefficients per coordinate + per-iteration history."""
@@ -211,7 +241,7 @@ def run_coordinate_descent(
                 )
         history.append(iter_diag)
         if validator is not None:
-            metric = validator(coefs, total)
+            metric = _call_validator(validator, coefs, total)
             validation_history.append(metric)
             if isinstance(metric, dict):
                 fields = {str(getattr(k, "value", k)): float(v)

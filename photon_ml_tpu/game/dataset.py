@@ -259,6 +259,10 @@ class GameDataset:
         # on any production loop (SURVEY §7 scale doctrine).
         from photon_ml_tpu.data.sparse_rows import SparseRows
 
+        # Copy before normalizing: the caller may retain (or share) the
+        # dict it passed in, and replacing its values in place would be
+        # a surprising side effect (advisor finding).
+        self.features = dict(self.features)
         for s, f in self.features.items():
             if not isinstance(f, (np.ndarray, SparseRows)):
                 self.features[s] = SparseRows.from_rows(f)

@@ -116,7 +116,8 @@ def lib() -> "ctypes.CDLL | None":
         dll.pml_grr_plan.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int64,
         ]
         dll.pml_grr_plan_sizes.argtypes = [
             ctypes.c_void_p,
@@ -263,6 +264,7 @@ def grr_plan_native(
     table_len: int,
     n_segments: int,
     cap: int | None = None,
+    idx_range: "tuple[int, int] | None" = None,
 ):
     """One GRR direction's plan straight from the row-ELL arrays, or
     None when the native library is unavailable (numpy path in
@@ -271,6 +273,12 @@ def grr_plan_native(
     ``direction`` 0: idx=column, seg=row (the margins X·w direction);
     1: idx=row, seg=column (the gradient Xᵀr direction).  Entries with
     value 0 are dropped (zero the hot-column entries before calling).
+    ``idx_range=(lo, hi)`` restricts the plan to table indices in
+    [lo, hi) — entries outside are skipped (they belong to a sibling
+    column-range sub-plan), indices are rebased to idx-lo, and the
+    returned plan's table axis is [0, hi-lo); ``lo`` must be
+    window-aligned (a multiple of 16384).  Indices outside
+    [0, table_len) are still an error.
     Returns a dict with the plan arrays (hi/vals/dst per supertile,
     block maps, spill COO) and the chosen cap; route coloring is the
     caller's next step (``grr_routes_native``).
@@ -294,9 +302,10 @@ def grr_plan_native(
     # means "choose from occupancy".
     if cap is not None and cap not in (1, 2, 4, 8, 16, 32, 64, 128):
         raise ValueError(f"cap must be a power of two ≤ 128, got {cap}")
+    lo, hi = idx_range if idx_range is not None else (0, int(table_len))
     handle = dll.pml_grr_plan(
         _ptr(cols), _ptr(vals), n, k, int(direction), int(table_len),
-        int(n_segments), 0 if cap is None else int(cap),
+        int(n_segments), 0 if cap is None else int(cap), int(lo), int(hi),
     )
     if not handle:
         raise MemoryError("pml_grr_plan allocation failed")

@@ -427,9 +427,19 @@ inline int32_t grr_next_pow2(int64_t x) {
 // Body behind an exception firewall: std::bad_alloc must not unwind
 // through the extern "C"/ctypes boundary (that would terminate the
 // process instead of letting the caller fall back to numpy).
+//
+// [idx_lo, idx_hi) restricts the plan to a contiguous sub-range of the
+// table axis (the column-range split of data/grr.py): entries outside
+// the range are SKIPPED (they belong to a sibling sub-plan — not spill,
+// not an error), in-range indices are rebased to idx - idx_lo, and the
+// emitted plan's table axis is [0, idx_hi - idx_lo).  Indices outside
+// [0, table_len) are still a hard error — every entry belongs to
+// exactly one range of a full partition, so a genuinely out-of-range
+// id must not be silently dropped by all parts.
 void grr_plan_body(GrrPlan* plan, const int32_t* cols, const float* vals,
                    int64_t n, int64_t k, int32_t direction,
-                   int64_t table_len, int64_t n_segments, int32_t cap_in) {
+                   int64_t table_len, int64_t n_segments, int32_t cap_in,
+                   int64_t idx_lo, int64_t idx_hi) {
   // Same cap validation as the numpy path (data/grr.py): a non-power-
   // of-two cap makes distinct (q, b) pairs collide on one final slot.
   if (cap_in != 0 && cap_in != 1 && cap_in != 2 && cap_in != 4 &&
@@ -439,7 +449,14 @@ void grr_plan_body(GrrPlan* plan, const int32_t* cols, const float* vals,
     return;
   }
   constexpr int64_t kMaxCounterBytes = int64_t{1} << 33;  // 8 GB
-  const int64_t n_gw = table_len > 0 ? (table_len + GRR_WIN - 1) / GRR_WIN : 1;
+  if (idx_hi <= 0) idx_hi = table_len;
+  if (idx_lo < 0 || idx_hi > table_len || idx_lo >= idx_hi ||
+      (idx_lo % GRR_WIN) != 0) {
+    plan->error = 3;
+    return;
+  }
+  const int64_t range_len = idx_hi - idx_lo;
+  const int64_t n_gw = (range_len + GRR_WIN - 1) / GRR_WIN;
   plan->n_gw = static_cast<int32_t>(n_gw);
   const int64_t m_ell = n * k;
 
@@ -452,12 +469,14 @@ void grr_plan_body(GrrPlan* plan, const int32_t* cols, const float* vals,
     if (v == 0.0f) continue;
     const int64_t r = e / k;
     const int64_t c = cols[e];
-    const int64_t idx = direction ? r : c;
+    int64_t idx = direction ? r : c;
     const int64_t seg = direction ? c : r;
     if (idx < 0 || idx >= table_len || seg < 0 || seg >= n_segments) {
       plan->error = 1;
       return;
     }
+    if (idx < idx_lo || idx >= idx_hi) continue;
+    idx -= idx_lo;
     const int64_t key = seg * n_gw + idx / GRR_WIN;
     if (key < prev_key) sorted = false;
     prev_key = key;
@@ -476,8 +495,10 @@ void grr_plan_body(GrrPlan* plan, const int32_t* cols, const float* vals,
         if (vals[e] == 0.0f) continue;
         const int64_t r = e / k;
         const int64_t c = cols[e];
+        const int64_t idx = direction ? r : c;
+        if (idx < idx_lo || idx >= idx_hi) continue;
         const int64_t key = (direction ? c : r) * n_gw +
-                            (direction ? r : c) / GRR_WIN;
+                            (idx - idx_lo) / GRR_WIN;
         if (key != prev_key) ++n_groups;
         prev_key = key;
       }
@@ -492,8 +513,10 @@ void grr_plan_body(GrrPlan* plan, const int32_t* cols, const float* vals,
         if (vals[e] == 0.0f) continue;
         const int64_t r = e / k;
         const int64_t c = cols[e];
+        const int64_t idx = direction ? r : c;
+        if (idx < idx_lo || idx >= idx_hi) continue;
         const int64_t key = (direction ? c : r) * n_gw +
-                            (direction ? r : c) / GRR_WIN;
+                            (idx - idx_lo) / GRR_WIN;
         if (!visited[key]) { visited[key] = 1; ++n_groups; }
       }
     }
@@ -535,8 +558,10 @@ void grr_plan_body(GrrPlan* plan, const int32_t* cols, const float* vals,
       if (v == 0.0f) continue;
       const int64_t r = e / k;
       const int64_t c = cols[e];
-      const int64_t idx = direction ? r : c;
+      int64_t idx = direction ? r : c;
       const int64_t seg = direction ? c : r;
+      if (idx < idx_lo || idx >= idx_hi) continue;
+      idx -= idx_lo;
       const int64_t gw = idx / GRR_WIN;
       int64_t q;
       if (sorted) {
@@ -616,8 +641,10 @@ void grr_plan_body(GrrPlan* plan, const int32_t* cols, const float* vals,
       if (v == 0.0f) continue;
       const int64_t r = e / k;
       const int64_t c = cols[e];
-      const int64_t idx = direction ? r : c;
+      int64_t idx = direction ? r : c;
       const int64_t seg = direction ? c : r;
+      if (idx < idx_lo || idx >= idx_hi) continue;
+      idx -= idx_lo;
       const int64_t gw = idx / GRR_WIN;
       int64_t q;
       if (sorted) {
@@ -698,12 +725,13 @@ extern "C" {
 
 void* pml_grr_plan(const int32_t* cols, const float* vals, int64_t n,
                    int64_t k, int32_t direction, int64_t table_len,
-                   int64_t n_segments, int32_t cap_in) {
+                   int64_t n_segments, int32_t cap_in, int64_t idx_lo,
+                   int64_t idx_hi) {
   auto* plan = new (std::nothrow) GrrPlan();
   if (!plan) return nullptr;
   try {
     grr_plan_body(plan, cols, vals, n, k, direction, table_len,
-                  n_segments, cap_in);
+                  n_segments, cap_in, idx_lo, idx_hi);
   } catch (const std::bad_alloc&) {
     plan->error = 2;  // caller falls back to the numpy path
   }

@@ -43,6 +43,18 @@ from photon_ml_tpu.parallel.mesh import DATA_AXIS, batch_spec
 Array = jax.Array
 
 
+def _vma(batch) -> bool:
+    """Whether shard_map may validate varying-mesh-axes for this batch.
+
+    Only the GRR layout must disable it: pallas_call (the GRR kernel)
+    cannot annotate vma on its out_shape, which vma checking requires of
+    everything inside a shard_map.  Every other layout (colmajor/ELL/
+    dense) keeps the validation on, so replication bugs on those paths
+    still fail loudly (advisor finding).
+    """
+    return getattr(batch, "grr", None) is None
+
+
 @struct.dataclass
 class DistributedGLMObjective:
     """GLMObjective over a batch sharded on the mesh's data axis.
@@ -63,10 +75,7 @@ class DistributedGLMObjective:
 
     # Each method shard_maps a closure running the LOCAL fused pipeline and
     # psumming the [dim]-or-scalar partials.  w is replicated (in_spec P()),
-    # batch leaves are example-sharded (P('data')).  check_vma=False:
-    # pallas_call (the GRR kernel) cannot annotate varying-mesh-axes on
-    # its out_shape, which vma checking requires of everything inside a
-    # shard_map.
+    # batch leaves are example-sharded (P('data')).
 
     def value(self, w: Array, batch: Batch) -> Array:
         def local(w, batch):
@@ -74,7 +83,7 @@ class DistributedGLMObjective:
 
         val = jax.shard_map(
             local, mesh=self.mesh, in_specs=(P(), batch_spec()),
-            out_specs=P(), check_vma=False,
+            out_specs=P(), check_vma=_vma(batch),
         )(w, batch)
         return val + self.objective.reg.l2_value(w)
 
@@ -85,7 +94,7 @@ class DistributedGLMObjective:
 
         v, g = jax.shard_map(
             local, mesh=self.mesh, in_specs=(P(), batch_spec()),
-            out_specs=(P(), P()), check_vma=False,
+            out_specs=(P(), P()), check_vma=_vma(batch),
         )(w, batch)
         reg = self.objective.reg
         return v + reg.l2_value(w), g + reg.l2_gradient(w)
@@ -101,7 +110,7 @@ class DistributedGLMObjective:
 
         hv = jax.shard_map(
             local, mesh=self.mesh, in_specs=(P(), P(), batch_spec()),
-            out_specs=P(), check_vma=False,
+            out_specs=P(), check_vma=_vma(batch),
         )(w, v, batch)
         return hv + self.objective.reg.l2_hessian_vector(v)
 
@@ -113,7 +122,7 @@ class DistributedGLMObjective:
 
         hd = jax.shard_map(
             local, mesh=self.mesh, in_specs=(P(), batch_spec()),
-            out_specs=P(), check_vma=False,
+            out_specs=P(), check_vma=_vma(batch),
         )(w, batch)
         return hd + self.objective.reg.l2_hessian_diagonal(w)
 
@@ -122,7 +131,7 @@ class DistributedGLMObjective:
         return jax.shard_map(
             lambda w, b: self._data_obj.predict_margins(w, b),
             mesh=self.mesh, in_specs=(P(), batch_spec()),
-            out_specs=batch_spec(), check_vma=False,
+            out_specs=batch_spec(), check_vma=_vma(batch),
         )(w, batch)
 
     def x_dot(self, v: Array, batch: Batch) -> Array:
@@ -132,5 +141,5 @@ class DistributedGLMObjective:
         return jax.shard_map(
             lambda v, b: b.x_dot(v),
             mesh=self.mesh, in_specs=(P(), batch_spec()),
-            out_specs=batch_spec(), check_vma=False,
+            out_specs=batch_spec(), check_vma=_vma(batch),
         )(v, batch)

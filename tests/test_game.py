@@ -343,3 +343,29 @@ def test_two_random_effects_config5_shape(rng):
     assert res_2re.validation_history[-1] > res_1re.validation_history[-1], (
         "adding the item effect must improve fit on item-effect data"
     )
+
+
+@pytest.mark.fast
+def test_validator_arity_shim():
+    """Legacy one-arg validators keep working; optional extras on a
+    legacy validator must not flip it to the new calling convention
+    (review finding)."""
+    from photon_ml_tpu.game.coordinate_descent import _call_validator
+
+    calls = {}
+    _call_validator(lambda total: calls.setdefault("legacy", total),
+                    {"c": 1}, "T")
+    assert calls["legacy"] == "T"
+
+    def legacy_with_extra(total_scores, sample_weight=None):
+        calls["extra"] = (total_scores, sample_weight)
+    _call_validator(legacy_with_extra, {"c": 1}, "T")
+    assert calls["extra"] == ("T", None)
+
+    def new_style(coefs, total):
+        calls["new"] = (coefs, total)
+    _call_validator(new_style, {"c": 1}, "T")
+    assert calls["new"] == ({"c": 1}, "T")
+
+    _call_validator(lambda *a: calls.setdefault("varpos", a), {"c": 1}, "T")
+    assert calls["varpos"] == ({"c": 1}, "T")

@@ -515,3 +515,132 @@ def test_overflow_chain_depth_capped(rng):
         depth += 1
         d = d.overflow
     assert depth <= 5          # lvl1 + at most overflow_depth=4 levels
+
+
+def _powerlaw_ell(rng, n, k, dim, x0=3000.0):
+    """Reciprocal (CTR-shaped) column popularity: P(col) ∝ 1/(col+x0),
+    concentrating ~half the mass in table window 0 while spreading it
+    across the window (the KDD shape PERF.md's range-split lever
+    targets)."""
+    u = rng.uniform(size=(n, k))
+    cols = np.minimum(x0 * np.exp(u * np.log((dim + x0) / x0)) - x0,
+                      dim - 1).astype(np.int32)
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    return cols, vals
+
+
+def test_plan_col_ranges_uniform_none(rng):
+    from photon_ml_tpu.data.grr import _plan_col_ranges
+
+    n, k, dim = 5000, 8, 70000
+    cols = rng.integers(0, dim, (n, k)).astype(np.int32)
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    assert _plan_col_ranges(cols, vals, dim) is None
+    # single-window dims can never split
+    assert _plan_col_ranges(cols % 9000, vals, 9000) is None
+    # denser uniform data with an UNALIGNED dim must not split either:
+    # the partial trailing window's occupancy is lower only because the
+    # window is narrower (review finding — this exact shape used to
+    # return a spurious 2-part split)
+    n, k = 12000, 20
+    cols = rng.integers(0, dim, (n, k)).astype(np.int32)
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    assert _plan_col_ranges(cols, vals, dim) is None
+
+
+def test_plan_col_ranges_powerlaw(rng):
+    from photon_ml_tpu.data.grr import WIN, _plan_col_ranges
+
+    n, k, dim = 12000, 20, 70000
+    cols, vals = _powerlaw_ell(rng, n, k, dim)
+    ranges = _plan_col_ranges(cols, vals, dim)
+    assert ranges is not None and len(ranges) >= 2
+    # window-aligned contiguous partition of [0, dim)
+    assert ranges[0][0] == 0 and ranges[-1][1] == dim
+    for (lo, hi, frac), (lo2, _, _) in zip(ranges, ranges[1:]):
+        assert hi == lo2 and lo % WIN == 0
+    assert abs(sum(f for _, _, f in ranges) - 1.0) < 1e-9
+
+
+def test_col_range_split_matches_global(rng):
+    """The split row plan must reproduce the global plan's contraction
+    exactly (same products, reordered sums) and the direct reference."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.grr import GrrRangeSplit
+
+    n, k, dim = 12000, 20, 70000
+    cols, vals = _powerlaw_ell(rng, n, k, dim)
+    pg = build_grr_pair(cols, vals, dim, col_range_split=False)
+    ps = build_grr_pair(cols, vals, dim, col_range_split=True)
+    assert isinstance(ps.row_dir, GrrRangeSplit)
+    assert not isinstance(pg.row_dir, GrrRangeSplit)
+
+    w = rng.normal(0, 1, dim).astype(np.float32)
+    a = np.asarray(pg.dot(jnp.asarray(w)))
+    b = np.asarray(ps.dot(jnp.asarray(w)))
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+    direct = np.zeros(n, np.float64)
+    np.add.at(direct, np.repeat(np.arange(n), k),
+              (vals.astype(np.float64) * w[cols]).reshape(-1))
+    np.testing.assert_allclose(b, direct, rtol=2e-3, atol=2e-3)
+    r = rng.normal(0, 1, n).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pg.t_dot(jnp.asarray(r))),
+                               np.asarray(ps.t_dot(jnp.asarray(r))),
+                               rtol=2e-4, atol=2e-4)
+    # squared() (hessian-diagonal path) survives the split
+    np.testing.assert_allclose(
+        np.asarray(pg.squared().dot(jnp.asarray(w))),
+        np.asarray(ps.squared().dot(jnp.asarray(w))),
+        rtol=2e-4, atol=2e-4)
+
+
+def test_col_range_split_reduces_spill(rng):
+    """On power-law columns the per-range capacities must hold in the
+    level-1 kernel what the single global cap pushed to overflow/COO
+    (round-4 verdict item #1's 'done' criterion)."""
+    n, k, dim = 12000, 20, 70000
+    cols, vals = _powerlaw_ell(rng, n, k, dim)
+    sg = build_grr_pair(
+        cols, vals, dim, col_range_split=False).row_dir.plan_stats()
+    ss = build_grr_pair(
+        cols, vals, dim, col_range_split=True).row_dir.plan_stats()
+    assert ss["spill_frac"] < sg["spill_frac"] / 3
+    assert ss["coo_frac"] < 0.01
+    assert len(set(ss["cap"])) >= 2   # ranges actually chose own caps
+
+
+def test_idx_range_native_matches_numpy(rng):
+    """The C++ builder's in-stream range filter must agree with the
+    numpy fallback's filtered-COO build."""
+    import jax.numpy as jnp
+
+    import photon_ml_tpu.native as nat
+    from photon_ml_tpu.data.grr import WIN, _build_direction_ell
+
+    if not nat.native_available():
+        pytest.skip("native library unavailable")
+    n, k, dim = 3000, 10, 50000
+    cols, vals = _powerlaw_ell(rng, n, k, dim, x0=2000.0)
+    vals[rng.random((n, k)) < 0.1] = 0.0
+    lo, hi = WIN, 3 * WIN
+    d_native = _build_direction_ell(cols, vals, 0, dim, n, None, True,
+                                    None, idx_range=(lo, hi))
+    saved = nat._lib
+    nat._lib = None
+    try:
+        d_numpy = _build_direction_ell(cols, vals, 0, dim, n, None, True,
+                                       None, idx_range=(lo, hi))
+    finally:
+        nat._lib = saved
+    assert d_native.table_len == hi - lo == d_numpy.table_len
+    w = rng.normal(0, 1, dim).astype(np.float32)
+    out_n = np.asarray(d_native.contract(jnp.asarray(w[lo:hi])))
+    out_p = np.asarray(d_numpy.contract(jnp.asarray(w[lo:hi])))
+    np.testing.assert_allclose(out_n, out_p, rtol=2e-4, atol=2e-4)
+    keep = (cols >= lo) & (cols < hi)
+    direct = np.zeros(n, np.float64)
+    np.add.at(direct, np.repeat(np.arange(n), k),
+              (np.where(keep, vals, 0).astype(np.float64)
+               * w[np.minimum(cols, dim - 1)]).reshape(-1))
+    np.testing.assert_allclose(out_n, direct, rtol=2e-3, atol=2e-3)
