@@ -98,9 +98,25 @@ def main(argv=None):
     ap.add_argument("--small", action="store_true",
                     help="10^5-example smoke run (CPU-friendly)")
     ap.add_argument("--n", type=int, default=None,
-                    help="example count (default 10^7; ~2x10^7 is the "
+                    help="example count (default 10^7; ~9x10^6 is the "
                          "largest class whose GRR plans fit one v5e's "
-                         "16 GB HBM — beyond that, shard over a mesh)")
+                         "16 GB HBM resident — beyond that, use "
+                         "--chunked or shard over a mesh)")
+    ap.add_argument("--chunked", type=int, default=None, metavar="ROWS",
+                    help="chunk-accumulated fixed-effect training "
+                         "(data/chunked_batch.py): examples per chunk; "
+                         "breaks the HBM residency wall")
+    ap.add_argument("--chunk-layout", default="AUTO",
+                    choices=["AUTO", "GRR", "ELL"],
+                    help="per-chunk layout: GRR = kernel-speed steps, "
+                         "~1.6 GB/1e6 examples streamed per pass (PCIe-"
+                         "class hosts); ELL = 8 B/nnz, ~20x smaller "
+                         "stream (transfer-bound links, e.g. this "
+                         "build box's axon tunnel)")
+    ap.add_argument("--chunk-resident", type=int, default=1,
+                    help="chunks kept live in HBM across passes (set "
+                         ">= n/chunk_rows when the compact layout fits "
+                         "— transfer then happens once)")
     ap.add_argument("--out", default=None, help="also write the JSON here")
     args = ap.parse_args(argv)
 
@@ -144,6 +160,9 @@ def main(argv=None):
         n_iterations=1,
         evaluators=[EvaluatorType.AUC],
         intercept=True,
+        chunk_rows=args.chunked,
+        chunk_layout=args.chunk_layout,
+        chunk_max_resident=args.chunk_resident,
     )
     est = GameEstimator(cfg)
     with log.timed("fit"):
@@ -167,6 +186,11 @@ def main(argv=None):
         "validation_auc": round(float(auc), 4),
         "peak_host_rss_gb": round(max_rss_gb(), 2),
         "phases": phases,
+        "chunked": (None if args.chunked is None else {
+            "chunk_rows": args.chunked,
+            "layout": args.chunk_layout,
+            "max_resident": args.chunk_resident,
+        }),
     }
     line = json.dumps(out)
     print(line)

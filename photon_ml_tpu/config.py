@@ -171,6 +171,21 @@ class TrainingConfig:
     # random-effect bucket blocks are entity-sharded (strategy #2).
     # None = single device.
     n_devices: int | None = None
+    # Chunk-accumulated (beyond-HBM-residency) fixed-effect training
+    # (reference: Spark streams splits through executors, SURVEY §1
+    # L1/§5.8; see data/chunked_batch.py): when set, sparse fixed
+    # effects are compiled into ceil(n/chunk_rows) congruent chunk
+    # batches streamed through HBM per objective evaluation, solved by
+    # the host-driven streaming L-BFGS.  Composes with n_devices
+    # (chunks × shards).  chunk_layout picks the per-chunk layout: AUTO
+    # = GRR on TPU (kernel-speed steps, ~1.6 GB/10⁶ examples streamed)
+    # else ELL (8 bytes/nnz — when transfer dominates).
+    # chunk_max_resident chunks stay live in HBM across evaluations
+    # (set ≥ n_chunks when the dataset fits; transfer then happens
+    # once).
+    chunk_rows: int | None = None
+    chunk_layout: str = "AUTO"
+    chunk_max_resident: int = 1
     # When set, the driver's fit phase runs under jax.profiler.trace
     # and a TensorBoard/XProf device trace is written here (SURVEY §5.1).
     profile_dir: str | None = None
@@ -213,6 +228,29 @@ class TrainingConfig:
             raise ValueError("model_output_mode must be ALL|BEST|EXPLICIT")
         if self.sparse_layout not in ("AUTO", "GRR", "COLMAJOR", "ELL"):
             raise ValueError("sparse_layout must be AUTO|GRR|COLMAJOR|ELL")
+        if self.chunk_layout not in ("AUTO", "GRR", "ELL"):
+            raise ValueError("chunk_layout must be AUTO|GRR|ELL")
+        if self.chunk_rows is not None:
+            if self.chunk_rows <= 0:
+                raise ValueError("chunk_rows must be positive")
+            if self.chunk_max_resident < 0:
+                raise ValueError("chunk_max_resident must be >= 0")
+            for c in self.coordinates:
+                if (c.kind == CoordinateKind.FIXED_EFFECT
+                        and c.down_sampling_rate is not None):
+                    raise ValueError(
+                        "down-sampling is not supported with chunked "
+                        "training (chunk_rows)")
+                if (c.kind == CoordinateKind.FIXED_EFFECT
+                        and c.optimizer.variance_type.value == "FULL"):
+                    raise ValueError(
+                        "FULL variances materialize a [d, d] Hessian — "
+                        "not supported with chunked training "
+                        "(chunk_rows); use SIMPLE")
+            if self.normalization != NormalizationType.NONE:
+                raise ValueError(
+                    "normalization requires resident feature statistics; "
+                    "not supported with chunked training (chunk_rows)")
         if self.n_devices is not None:
             if self.n_devices <= 0:
                 raise ValueError("n_devices must be positive")

@@ -1,0 +1,218 @@
+"""Chunked batches: the beyond-HBM-residency training class.
+
+Reference counterpart: Spark never holds a dataset on one machine — it
+streams HDFS splits through executors and recomputes from lineage, so
+the trainable size is bounded by the CLUSTER, not one host (SURVEY.md
+§1 L1, §5.8 [expected structure, mount unavailable]).  The resident
+TPU path inverts that trade: a compiled GRR plan must live in HBM for
+the whole fit (~1.6 GB per 10⁶ examples measured, PERF.md), capping a
+16 GB v5e chip at ~9×10⁶ examples.
+
+This module removes the cap the same way Spark does — by streaming —
+while keeping every FLOP on the TPU: the dataset is compiled ONCE into
+K congruent chunk batches (identical pytree structure and leaf shapes,
+the same trick the mesh-sharded build uses for multi-device
+congruence), and every objective evaluation streams chunks through HBM,
+accumulating (loss, gradient, HVP, Hessian-diagonal) partials on
+device.  Every data-side quantity the GLM objective computes is a
+linear reduction over examples, so chunked accumulation is EXACT up to
+float-summation reordering (tested against the resident path).
+
+Because the chunks are congruent, the per-chunk device program compiles
+once and replays K times per pass; ``optim.streaming`` double-buffers
+the host→device transfer of chunk i+1 under chunk i's compute, and
+keeps up to ``max_resident`` chunks live in HBM so datasets that DO fit
+pay the transfer once (the resident and streaming regimes are one code
+path).
+
+Layouts per chunk (``layout=``):
+- ``"grr"`` — compiled GRR plans (``data.grr.build_sharded_grr_pairs``,
+  chunks-as-shards): kernel-speed steps; ~1.6 GB/10⁶ examples streamed
+  per pass — right when host↔device bandwidth is PCIe-class.
+- ``"ell"`` — plain ELL (8 bytes/nnz): XLA gather/scatter steps, ~20×
+  smaller stream; right when transfer dominates (or when even the ELL
+  no longer fits and streaming volume is the binding cost).
+
+With ``mesh=``, chunks × shards compose: each chunk is built as
+congruent PER-DEVICE sub-batches (one more level of the same
+congruence) and assembled onto the mesh per use; gradient partials
+then meet in the distributed objective's existing psum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import numpy as np
+
+from photon_ml_tpu.data.batch import SparseBatch
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ChunkedBatch:
+    """K congruent host-resident chunk batches over one example axis.
+
+    ``chunks[i]`` is a ``SparseBatch`` with HOST (numpy) leaves — or,
+    when ``mesh`` is set, a list of per-device host sub-batches to be
+    assembled example-sharded on use.  All chunks have identical pytree
+    structure and leaf shapes (one compile serves all).
+    """
+
+    chunks: list
+    dim: int
+    n: int                 # real examples (before padding)
+    chunk_rows: int        # examples per chunk (last chunk padded)
+    layout: str
+    mesh: object | None = None   # jax.sharding.Mesh | None
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.chunks)
+
+    def chunk_slice(self, i: int) -> tuple[int, int]:
+        """Real-example range [lo, hi) covered by chunk i."""
+        lo = i * self.chunk_rows
+        return lo, min(lo + self.chunk_rows, self.n)
+
+    def set_offsets(self, offsets: np.ndarray) -> None:
+        """Install new per-example offsets (GAME coordinate-descent
+        residual passing) into the host chunks, zero-padded to the
+        chunk grid.  Callers holding device copies must invalidate
+        them (``optim.streaming.ChunkedGLMObjective.invalidate``)."""
+        offsets = np.asarray(offsets, np.float32)
+        if offsets.shape[0] != self.n:
+            raise ValueError(
+                f"offsets length {offsets.shape[0]} != n {self.n}")
+        for i in range(self.n_chunks):
+            lo, hi = self.chunk_slice(i)
+            pad = np.zeros(self.chunk_rows, np.float32)
+            pad[: hi - lo] = offsets[lo:hi]
+            if self.mesh is None:
+                self.chunks[i] = self.chunks[i].replace(offsets=pad)
+            else:
+                per = self.chunk_rows // len(self.chunks[i])
+                self.chunks[i] = [
+                    b.replace(offsets=pad[j * per:(j + 1) * per])
+                    for j, b in enumerate(self.chunks[i])
+                ]
+
+
+def _host_chunk(cols, vals, labels, weights, offsets, mask, dim,
+                grr=None) -> SparseBatch:
+    """A SparseBatch with host numpy leaves (no device placement)."""
+    return SparseBatch(
+        values=np.asarray(vals, np.float32),
+        col_ids=np.asarray(cols, np.int32),
+        labels=np.asarray(labels, np.float32),
+        weights=np.asarray(weights, np.float32),
+        offsets=np.asarray(offsets, np.float32),
+        mask=np.asarray(mask, np.float32),
+        dim=dim,
+        grr=grr,
+    )
+
+
+def build_chunked_batch(
+    rows,
+    dim: int,
+    labels: np.ndarray,
+    weights: np.ndarray | None = None,
+    offsets: np.ndarray | None = None,
+    chunk_rows: int | None = None,
+    n_chunks: int | None = None,
+    layout: str = "grr",
+    mesh=None,
+    row_capacity: int | None = None,
+    drop_ell_with_grr: bool = True,
+) -> ChunkedBatch:
+    """Compile a dataset into K congruent host chunk batches.
+
+    ``rows``: ``SparseRows`` (scale path) or list of (col_ids, values)
+    pairs.  Exactly one of ``chunk_rows`` / ``n_chunks`` must be given.
+    ``layout``: "grr" or "ell" (see module docstring).  With ``mesh``,
+    each chunk is split further into one congruent sub-batch per mesh
+    device (chunks × shards).
+
+    The GRR chunk plans are built by the SAME congruent-shapes builder
+    the mesh path uses (chunks are shards of the example axis either
+    way); hot/mid column sets and capacities are global across chunks,
+    so one compiled contraction program serves every chunk.
+    """
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+
+    if not isinstance(rows, SparseRows):
+        rows = SparseRows.from_rows(rows)
+    if layout not in ("grr", "ell"):
+        raise ValueError(f"unknown chunk layout {layout!r} "
+                         "(supported: 'grr', 'ell')")
+    n = len(labels)
+    if (chunk_rows is None) == (n_chunks is None):
+        raise ValueError("give exactly one of chunk_rows / n_chunks")
+    n_dev = 1 if mesh is None else mesh.devices.size
+    if n_chunks is not None:
+        chunk_rows = -(-n // n_chunks)
+    # Pieces must be equal-size: round chunk_rows up to the device grid.
+    chunk_rows = -(-chunk_rows // n_dev) * n_dev
+    n_chunks = -(-n // chunk_rows)
+    per = chunk_rows // n_dev
+    n_pieces = n_chunks * n_dev
+
+    weights = np.ones(n, np.float32) if weights is None else np.asarray(
+        weights, np.float32)
+    offsets = np.zeros(n, np.float32) if offsets is None else np.asarray(
+        offsets, np.float32)
+    labels = np.asarray(labels, np.float32)
+    k = row_capacity if row_capacity is not None else max(rows.max_nnz, 1)
+
+    def piece_arrays(p):
+        lo = p * per
+        hi = min(lo + per, n)
+        if lo >= n:
+            cols_p = np.zeros((per, k), np.int32)
+            vals_p = np.zeros((per, k), np.float32)
+            aux = [np.zeros(per, np.float32)] * 4
+            return cols_p, vals_p, aux
+        cols_p, vals_p = rows[lo:hi].to_ell(row_capacity=k, pad_to=per)
+        pad1 = lambda a: np.pad(
+            np.asarray(a[lo:hi], np.float32), (0, per - (hi - lo)))
+        mask = np.zeros(per, np.float32)
+        mask[: hi - lo] = 1.0
+        return cols_p, vals_p, [pad1(labels), pad1(weights),
+                                pad1(offsets), mask]
+
+    pieces_arr = [piece_arrays(p) for p in range(n_pieces)]
+
+    grr_pairs = [None] * n_pieces
+    if layout == "grr":
+        from photon_ml_tpu.data.grr import build_sharded_grr_pairs
+
+        grr_pairs = build_sharded_grr_pairs(
+            [c for c, _, _ in pieces_arr],
+            [v for _, v, _ in pieces_arr],
+            dim,
+        )
+
+    pieces = []
+    for (cols_p, vals_p, (lab, wt, off, mask)), pair in zip(pieces_arr,
+                                                            grr_pairs):
+        if pair is not None and drop_ell_with_grr:
+            # The plan serves every contraction; the ELL copy would
+            # only add 8 bytes/nnz to every chunk transfer.
+            cols_p = np.zeros((per, 0), np.int32)
+            vals_p = np.zeros((per, 0), np.float32)
+        pieces.append(_host_chunk(cols_p, vals_p, lab, wt, off, mask,
+                                  dim, grr=pair))
+
+    if mesh is None:
+        chunks = pieces
+    else:
+        chunks = [pieces[i * n_dev:(i + 1) * n_dev]
+                  for i in range(n_chunks)]
+    logger.info(
+        "chunked batch: n=%d -> %d chunks x %d rows (%s%s)", n, n_chunks,
+        chunk_rows, layout, f", {n_dev}-device mesh" if mesh else "")
+    return ChunkedBatch(chunks=chunks, dim=dim, n=n,
+                        chunk_rows=chunk_rows, layout=layout, mesh=mesh)

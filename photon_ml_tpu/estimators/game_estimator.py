@@ -164,6 +164,12 @@ class GameEstimator:
 
         intercept_index = None
         if isinstance(feats, np.ndarray):
+            if cfg.chunk_rows is not None:
+                raise ValueError(
+                    "chunk_rows supports sparse feature shards only; "
+                    f"fixed-effect shard '{coord_cfg.feature_shard}' is "
+                    "a dense array (a resident DenseBatch would defeat "
+                    "the beyond-HBM purpose of chunking)")
             x = np.asarray(feats, np.float32)
             if cfg.intercept:
                 x = np.concatenate([x, np.ones((len(x), 1), np.float32)], 1)
@@ -195,6 +201,33 @@ class GameEstimator:
                     ]
                 intercept_index = dim
                 dim += 1
+            if cfg.chunk_rows is not None:
+                # Chunk-accumulated path (beyond-HBM residency; SURVEY
+                # §1 L1): K congruent host chunk batches streamed per
+                # objective evaluation.  Composes with the mesh
+                # (chunks × shards).
+                from photon_ml_tpu.data.chunked_batch import (
+                    build_chunked_batch,
+                )
+
+                layout = cfg.chunk_layout
+                if layout == "AUTO":
+                    import jax
+
+                    layout = ("GRR" if jax.default_backend() == "tpu"
+                              else "ELL")
+                chunked = build_chunked_batch(
+                    rows, dim, labels, weights=weights,
+                    chunk_rows=cfg.chunk_rows, layout=layout.lower(),
+                    mesh=mesh,
+                )
+                return {
+                    "chunked": chunked, "batch": None,
+                    "norm": NormalizationContext.identity(), "dim": dim,
+                    "intercept_index": intercept_index,
+                    "train_idx": None, "train_weights": None,
+                    "mesh": mesh, "n_examples": train.n,
+                }
             if mesh is not None:
                 # Mesh path: per-shard layouts (each device indexes its
                 # own rows; SURVEY §5.8's one-time "shuffle").  AUTO
@@ -409,6 +442,20 @@ class GameEstimator:
                     norm=p["norm"],
                     prior=prior,
                 )
+                if p.get("chunked") is not None:
+                    from photon_ml_tpu.game.coordinates import (
+                        ChunkedFixedEffectCoordinate,
+                    )
+
+                    coords[coord_cfg.name] = ChunkedFixedEffectCoordinate(
+                        name=coord_cfg.name,
+                        chunked=p["chunked"],
+                        objective=objective,
+                        optimizer=coord_cfg.optimizer.optimizer,
+                        config=ocfg,
+                        max_resident=cfg.chunk_max_resident,
+                    )
+                    continue
                 distributed = None
                 if p["mesh"] is not None:
                     from photon_ml_tpu.parallel import DistributedGLMObjective

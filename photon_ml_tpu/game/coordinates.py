@@ -317,6 +317,92 @@ class FixedEffectCoordinate(Coordinate):
 
 
 @dataclasses.dataclass(eq=False)
+class ChunkedFixedEffectCoordinate(Coordinate):
+    """Fixed effect trained by chunk-accumulated streaming — the
+    beyond-HBM-residency class (reference: Spark streams splits through
+    executors, SURVEY §1 L1/§5.8; see ``data.chunked_batch``).
+
+    Same ``train``/``score`` contract as ``FixedEffectCoordinate``; the
+    solve is the host-driven ``optim.streaming.streaming_lbfgs_solve``
+    over a ``ChunkedGLMObjective`` (per-chunk device programs, exact
+    chunk-accumulated objective).  Down-sampling views and TRON are not
+    supported on this path (documented config error)."""
+
+    name: str
+    chunked: "object"                 # data.chunked_batch.ChunkedBatch
+    objective: GLMObjective           # reg/prior included (added once)
+    optimizer: "object"               # OptimizerType
+    config: OptimizerConfig
+    max_resident: int = 1
+
+    def __post_init__(self):
+        from photon_ml_tpu.optim.base import OptimizerType
+        from photon_ml_tpu.optim.streaming import ChunkedGLMObjective
+
+        if self.optimizer == OptimizerType.TRON:
+            raise ValueError(
+                "chunked training supports LBFGS/OWL-QN only (TRON's "
+                "inner CG would stream the dataset once per CG step)")
+        self._obj = ChunkedGLMObjective(
+            self.objective, self.chunked, max_resident=self.max_resident)
+
+    @property
+    def problem(self) -> OptimizationProblem:
+        """Estimator-facing surface parity with FixedEffectCoordinate
+        (model export reads ``coord.problem.objective.norm``)."""
+        return OptimizationProblem(
+            objective=self.objective, optimizer=self.optimizer,
+            config=self.config)
+
+    def initial_coefficients(self) -> Array:
+        return jnp.zeros((self.chunked.dim,), jnp.float32)
+
+    def train(self, offsets: Array, warm_start: Array | None = None,
+              donate_warm_start: bool = False):
+        from photon_ml_tpu.optim.streaming import streaming_lbfgs_solve
+
+        off = np.asarray(offsets, np.float32)
+        if off.shape[0] != self.chunked.n:
+            off = off[: self.chunked.n]
+        self.chunked.set_offsets(off)
+        self._obj.invalidate()
+        w0 = (self.initial_coefficients() if warm_start is None
+              else warm_start)
+        problem = self.problem
+        l1 = (problem._l1_vector(self.chunked.dim) if problem.has_l1()
+              else None)
+        res = streaming_lbfgs_solve(
+            self._obj.value_and_gradient, w0, self.config, l1_weight=l1)
+        return res.w, res
+
+    def score(self, coefficients: Array) -> Array:
+        """Raw X·w per example — offset-free, the same
+        ``CoordinateDataScores`` convention as the resident path."""
+        return jnp.asarray(self._obj.x_dot(coefficients))
+
+    def as_model(self, coefficients: Array) -> FixedEffectModel:
+        return FixedEffectModel(
+            coefficients=Coefficients(means=coefficients),
+            feature_shard=self.name,
+        )
+
+    def compute_variances(self, coefficients: Array, offsets: Array,
+                          variance_type) -> Array | None:
+        from photon_ml_tpu.optim.variance import VarianceComputationType
+
+        if variance_type == VarianceComputationType.NONE:
+            return None
+        if variance_type == VarianceComputationType.FULL:
+            raise ValueError(
+                "FULL variances materialize a [d, d] Hessian — not "
+                "supported on the chunked path; use SIMPLE")
+        self.chunked.set_offsets(np.asarray(offsets, np.float32))
+        self._obj.invalidate()
+        diag = self._obj.hessian_diagonal(coefficients)
+        return 1.0 / jnp.maximum(diag, 1e-12)
+
+
+@dataclasses.dataclass(eq=False)
 class RandomEffectCoordinate(Coordinate):
     """Entity-sharded solves, one vmapped batch per size bucket
     (reference ``RandomEffectCoordinate``)."""

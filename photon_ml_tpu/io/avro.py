@@ -16,9 +16,13 @@ and vice versa.
 
 Scope (documented subset): all primitive types, record / enum / fixed /
 array / map / union named types, recursive name references, ``null`` and
-``deflate`` codecs.  Schema-evolution (separate reader schema) is not
-implemented — readers decode with the writer schema embedded in the
-container, which is all the framework's own pipelines need.
+``deflate`` codecs, and schema RESOLUTION (Avro spec §"Schema
+Resolution"): ``read_container(path, reader_schema=...)`` decodes with
+the container's embedded writer schema and resolves each datum to the
+caller's reader schema — writer-only fields are skipped, reader-only
+fields take their defaults, primitives promote (int→long→float→double,
+string↔bytes), unions resolve branch-wise — so files written by evolved
+reference pipelines stay readable.
 
 This is host-side ETL: nothing here touches jax.  Device code only ever
 sees the int32/float32 arrays produced downstream (``io.dataset``).
@@ -269,6 +273,184 @@ def _decode(schema: Schema, s: Any, inp: BinaryIO) -> Any:
     raise TypeError(f"unsupported schema {s!r}")
 
 
+# ---------------------------------------------------------------------------
+# Schema resolution (Avro spec §"Schema Resolution"): decode with the
+# WRITER schema's wire layout, produce data shaped by the READER schema.
+# ---------------------------------------------------------------------------
+
+_PROMOTIONS = {
+    ("int", "long"), ("int", "float"), ("int", "double"),
+    ("long", "float"), ("long", "double"), ("float", "double"),
+    ("string", "bytes"), ("bytes", "string"),
+}
+
+
+def _type_of(s: Any) -> str:
+    return s if isinstance(s, str) else s["type"]
+
+
+def _schemas_match(wschema: "Schema", ws: Any, rschema: "Schema",
+                   rs: Any) -> bool:
+    """Can writer schema ``ws`` resolve to reader schema ``rs``?
+    (Shallow per spec — container element mismatches surface as errors
+    during decode, like reference implementations.)"""
+    ws = wschema.resolve(ws)
+    rs = rschema.resolve(rs)
+    if isinstance(ws, list) or isinstance(rs, list):
+        return True   # union resolution happens per-datum at decode
+    wt, rt = _type_of(ws), _type_of(rs)
+    if wt == rt:
+        if wt in ("record", "enum", "fixed"):
+            wn = ws["name"].rsplit(".", 1)[-1]
+            rn = rs["name"].rsplit(".", 1)[-1]
+            if wn != rn:
+                return False
+            if wt == "fixed":
+                return ws["size"] == rs["size"]
+        return True
+    return (wt, rt) in _PROMOTIONS
+
+
+def _promote(value: Any, wt: str, rt: str) -> Any:
+    if rt in ("float", "double") and wt in ("int", "long", "float"):
+        return float(value)
+    if wt == "string" and rt == "bytes":
+        return value.encode("utf-8") if isinstance(value, str) else value
+    if wt == "bytes" and rt == "string":
+        return value.decode("utf-8") if isinstance(value, bytes) else value
+    return value
+
+
+def _default_datum(rschema: "Schema", rs: Any, default: Any) -> Any:
+    """A reader field's JSON default → runtime datum (spec: bytes/fixed
+    defaults are JSON strings of latin-1 code points; union defaults
+    conform to the FIRST branch)."""
+    rs = rschema.resolve(rs)
+    if isinstance(rs, list):
+        return _default_datum(rschema, rs[0], default)
+    t = _type_of(rs)
+    if t in ("bytes", "fixed") and isinstance(default, str):
+        return default.encode("latin-1")
+    if t == "record":
+        return {
+            f["name"]: _default_datum(
+                rschema, f["type"],
+                default.get(f["name"], f.get("default")))
+            for f in rs["fields"]
+        }
+    if t == "array":
+        return [_default_datum(rschema, rs["items"], d) for d in default]
+    if t == "map":
+        return {k: _default_datum(rschema, rs["values"], v)
+                for k, v in default.items()}
+    return default
+
+
+def _skip(schema: Schema, s: Any, inp: BinaryIO) -> None:
+    """Decode-and-discard a writer-only value (spec: skipped fields)."""
+    _decode(schema, s, inp)
+
+
+def _decode_resolved(wschema: Schema, ws: Any, rschema: Schema, rs: Any,
+                     inp: BinaryIO) -> Any:
+    ws = wschema.resolve(ws)
+    rs = rschema.resolve(rs)
+    if isinstance(ws, list):
+        # Writer union: the wire carries the branch index; resolve the
+        # actual branch against the reader schema.
+        return _decode_resolved(wschema, ws[read_long(inp)], rschema, rs,
+                                inp)
+    if isinstance(rs, list):
+        # Reader union, writer not: first reader branch that matches.
+        for branch in rs:
+            if _schemas_match(wschema, ws, rschema, branch):
+                return _decode_resolved(wschema, ws, rschema, branch, inp)
+        raise TypeError(
+            f"writer schema {ws!r} matches no reader union branch {rs!r}")
+    wt, rt = _type_of(ws), _type_of(rs)
+    if wt != rt and (wt, rt) not in _PROMOTIONS:
+        raise TypeError(
+            f"cannot resolve writer {wt!r} to reader {rt!r}")
+    if wt == rt and wt in ("enum", "fixed"):
+        # Spec: named types resolve only when (unqualified) names match;
+        # fixed additionally requires equal sizes.  A silent fall-
+        # through here would yield writer-shaped bytes under a reader
+        # contract that promises something else (review finding).
+        wn = ws["name"].rsplit(".", 1)[-1]
+        rn = rs["name"].rsplit(".", 1)[-1]
+        if wn != rn:
+            raise TypeError(
+                f"{wt} name mismatch: writer {wn!r}, reader {rn!r}")
+        if wt == "fixed" and ws["size"] != rs["size"]:
+            raise TypeError(
+                f"fixed {wn!r} size mismatch: writer {ws['size']}, "
+                f"reader {rs['size']}")
+    if wt == "record":
+        wn = ws["name"].rsplit(".", 1)[-1]
+        rn = rs["name"].rsplit(".", 1)[-1]
+        if wn != rn:
+            raise TypeError(f"record name mismatch: writer {wn}, "
+                            f"reader {rn}")
+        r_fields = {f["name"]: f for f in rs["fields"]}
+        out = {}
+        for f in ws["fields"]:        # wire order = writer field order
+            rf = r_fields.pop(f["name"], None)
+            if rf is None:
+                _skip(wschema, f["type"], inp)
+            else:
+                out[rf["name"]] = _decode_resolved(
+                    wschema, f["type"], rschema, rf["type"], inp)
+        for name, rf in r_fields.items():   # reader-only → defaults
+            if "default" not in rf:
+                raise TypeError(
+                    f"record {rs['name']!r}: reader field {name!r} "
+                    "absent from writer data and has no default")
+            out[name] = _default_datum(rschema, rf["type"], rf["default"])
+        return out
+    if wt == "enum":
+        symbol = ws["symbols"][read_long(inp)]
+        if symbol not in rs["symbols"]:
+            if "default" in rs:       # Avro 1.9+ enum default
+                return rs["default"]
+            raise TypeError(
+                f"enum symbol {symbol!r} not in reader symbols")
+        return symbol
+    if wt == "array":
+        out = []
+        while True:
+            count = read_long(inp)
+            if count == 0:
+                return out
+            if count < 0:
+                read_long(inp)
+                count = -count
+            for _ in range(count):
+                out.append(_decode_resolved(
+                    wschema, ws["items"], rschema, rs["items"], inp))
+    if wt == "map":
+        out = {}
+        while True:
+            count = read_long(inp)
+            if count == 0:
+                return out
+            if count < 0:
+                read_long(inp)
+                count = -count
+            for _ in range(count):
+                k = inp.read(read_long(inp)).decode("utf-8")
+                out[k] = _decode_resolved(
+                    wschema, ws["values"], rschema, rs["values"], inp)
+    value = _decode(wschema, ws, inp)
+    return _promote(value, wt, rt)
+
+
+def decode_datum_resolved(wschema: Schema, rschema: Schema,
+                          raw: bytes) -> Any:
+    """Decode writer-layout bytes into reader-schema-shaped data."""
+    return _decode_resolved(wschema, wschema.root, rschema, rschema.root,
+                            io.BytesIO(raw))
+
+
 def encode_datum(schema: Schema, datum: Any) -> bytes:
     buf = io.BytesIO()
     _encode(schema, schema.root, datum, buf)
@@ -343,8 +525,21 @@ def write_container(
     return total
 
 
-def read_container(path: str) -> tuple[Schema, Iterator[Any]]:
-    """Open an Avro object container file → (writer schema, record iter)."""
+def read_container(
+    path: str,
+    reader_schema: "Schema | str | dict | None" = None,
+) -> tuple[Schema, Iterator[Any]]:
+    """Open an Avro object container file → (writer schema, record iter).
+
+    With ``reader_schema``, each record is RESOLVED writer→reader
+    (schema evolution): data written under an older/newer schema decodes
+    into the caller's shape — writer-only fields skipped, reader-only
+    fields defaulted, primitives promoted (Avro spec §"Schema
+    Resolution").  The returned schema is still the writer's (callers
+    inspecting the file's own layout keep working).
+    """
+    if reader_schema is not None and not isinstance(reader_schema, Schema):
+        reader_schema = Schema(reader_schema)
     f = open(path, "rb")
     if f.read(4) != MAGIC:
         f.close()
@@ -372,7 +567,13 @@ def read_container(path: str) -> tuple[Schema, Iterator[Any]]:
                 if f.read(SYNC_SIZE) != sync:
                     raise ValueError(f"{path}: sync marker mismatch")
                 buf = io.BytesIO(payload)
-                for _ in range(count):
-                    yield _decode(schema, schema.root, buf)
+                if reader_schema is None:
+                    for _ in range(count):
+                        yield _decode(schema, schema.root, buf)
+                else:
+                    for _ in range(count):
+                        yield _decode_resolved(
+                            schema, schema.root, reader_schema,
+                            reader_schema.root, buf)
 
     return schema, records()

@@ -1,0 +1,287 @@
+"""Chunk-accumulated (beyond-HBM) training: chunked ≡ resident.
+
+Round-4 verdict item #2: the objective is a pure sum over examples, so
+streaming K congruent chunk batches through the device and accumulating
+partials must reproduce the resident path exactly (float reordering
+only) — for value/gradient/HVP/Hessian-diagonal, for the host-driven
+streaming L-BFGS/OWL-QN solver, through the estimator, and composed
+with the 8-device mesh (chunks × shards).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import make_sparse_batch
+from photon_ml_tpu.data.chunked_batch import build_chunked_batch
+from photon_ml_tpu.data.normalization import NormalizationContext
+from photon_ml_tpu.data.sparse_rows import SparseRows
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.optim.base import OptimizerConfig
+from photon_ml_tpu.optim.lbfgs import lbfgs_solve
+from photon_ml_tpu.optim.streaming import (
+    ChunkedGLMObjective,
+    streaming_lbfgs_solve,
+)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _sparse_problem(rng, n=2000, d=900, k=8):
+    cols = np.stack([
+        np.sort(rng.choice(d, k, replace=False)) for _ in range(n)
+    ]).astype(np.int32)
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    w_true = rng.normal(0, 0.8, d) * (rng.uniform(size=d) < 0.3)
+    m = np.einsum("nk,nk->n", vals, w_true[cols])
+    labels = (rng.uniform(size=n) < 1 / (1 + np.exp(-m))).astype(np.float32)
+    weights = rng.uniform(0.5, 1.5, n).astype(np.float32)
+    offsets = rng.normal(0, 0.1, n).astype(np.float32)
+    indptr = np.arange(n + 1, dtype=np.int64) * k
+    rows = SparseRows.from_flat(indptr, cols.reshape(-1).astype(np.int64),
+                                vals.reshape(-1))
+    return rows, cols, vals, labels, weights, offsets
+
+
+def _objective(reg=None):
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+
+    return GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=reg if reg is not None else RegularizationContext.l2(0.7),
+        norm=NormalizationContext.identity(),
+    )
+
+
+@pytest.mark.parametrize("layout", ["ell", "grr"])
+@pytest.mark.parametrize("max_resident", [0, 8])
+def test_chunked_matches_resident(rng, layout, max_resident):
+    rows, cols, vals, labels, weights, offsets = _sparse_problem(rng)
+    d = 900
+    obj = _objective()
+    resident = make_sparse_batch(rows, d, labels, weights=weights,
+                                 offsets=offsets)
+    cb = build_chunked_batch(rows, d, labels, weights=weights,
+                             offsets=offsets, n_chunks=3, layout=layout)
+    assert cb.n_chunks == 3
+    cobj = ChunkedGLMObjective(obj, cb, max_resident=max_resident)
+
+    w = jnp.asarray(rng.normal(0, 0.2, d), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, d), jnp.float32)
+
+    f_r, g_r = obj.value_and_gradient(w, resident)
+    f_c, g_c = cobj.value_and_gradient(w)
+    np.testing.assert_allclose(float(f_c), float(f_r), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(g_c), np.asarray(g_r),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(float(cobj.value(w)),
+                               float(obj.value(w, resident)), rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(cobj.hessian_vector(w, v)),
+        np.asarray(obj.hessian_vector(w, v, resident)),
+        rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(cobj.hessian_diagonal(w)),
+        np.asarray(obj.hessian_diagonal(w, resident)),
+        rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        cobj.predict_margins(w),
+        np.asarray(obj.predict_margins(w, resident)),
+        rtol=2e-4, atol=2e-4)
+
+
+def test_chunked_prior_and_reg_added_once(rng):
+    """Example-independent terms (L2, Gaussian prior) must not scale
+    with the chunk count."""
+    from photon_ml_tpu.ops.prior import GaussianPrior
+
+    rows, cols, vals, labels, weights, offsets = _sparse_problem(rng)
+    d = 900
+    prior = GaussianPrior.from_model(
+        jnp.asarray(rng.normal(0, 0.3, d), jnp.float32),
+        jnp.ones((d,), jnp.float32), 2.0)
+    obj = _objective().replace(prior=prior)
+    resident = make_sparse_batch(rows, d, labels, weights=weights,
+                                 offsets=offsets)
+    for n_chunks in (2, 5):
+        cobj = ChunkedGLMObjective(
+            obj, build_chunked_batch(rows, d, labels, weights=weights,
+                                     offsets=offsets, n_chunks=n_chunks,
+                                     layout="ell"))
+        w = jnp.asarray(rng.normal(0, 0.2, d), jnp.float32)
+        f_r, g_r = obj.value_and_gradient(w, resident)
+        f_c, g_c = cobj.value_and_gradient(w)
+        np.testing.assert_allclose(float(f_c), float(f_r), rtol=2e-5)
+        np.testing.assert_allclose(np.asarray(g_c), np.asarray(g_r),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("l1", [None, 0.05])
+def test_streaming_lbfgs_matches_resident(rng, l1):
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+
+    reg = (RegularizationContext.l2(0.5) if l1 is None
+           else RegularizationContext.elastic_net(0.5, 0.3))
+    rows, cols, vals, labels, weights, offsets = _sparse_problem(rng)
+    d = 900
+    obj = _objective(reg)
+    resident = make_sparse_batch(rows, d, labels, weights=weights,
+                                 offsets=offsets)
+    cb = build_chunked_batch(rows, d, labels, weights=weights,
+                             offsets=offsets, n_chunks=4, layout="ell")
+    cobj = ChunkedGLMObjective(obj, cb, max_resident=4)
+    cfg = OptimizerConfig(max_iters=80, tolerance=1e-5)
+    w0 = jnp.zeros((d,), jnp.float32)
+    l1_vec = None
+    if l1 is not None:
+        l1_vec = jnp.broadcast_to(reg.l1_weight, (d,))
+
+    res_r = lbfgs_solve(lambda w: obj.value_and_gradient(w, resident),
+                        w0, cfg, l1_weight=l1_vec)
+    res_s = streaming_lbfgs_solve(cobj.value_and_gradient, w0, cfg,
+                                  l1_weight=l1_vec)
+    # Same convex problem, same algorithm: minima must agree tightly.
+    np.testing.assert_allclose(float(res_s.value), float(res_r.value),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(res_s.w), np.asarray(res_r.w),
+                               rtol=5e-3, atol=5e-3)
+    assert bool(res_s.converged) == bool(res_r.converged)
+    if l1 is not None:
+        # OWL-QN must produce sparsity, and the zero sets of the two
+        # paths must agree in size (same orthant-wise solution).
+        zeros_s = int(np.sum(np.asarray(res_s.w) == 0.0))
+        zeros_r = int(np.sum(np.asarray(res_r.w) == 0.0))
+        assert zeros_s > 20
+        assert abs(zeros_s - zeros_r) <= max(10, zeros_r // 5)
+
+
+def test_chunked_mesh_composes(rng):
+    """chunks × shards: each chunk assembled example-sharded on the
+    8-device mesh, partials psum-reduced, equal to resident."""
+    from photon_ml_tpu.parallel.mesh import data_parallel_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    rows, cols, vals, labels, weights, offsets = _sparse_problem(rng)
+    d = 900
+    obj = _objective()
+    resident = make_sparse_batch(rows, d, labels, weights=weights,
+                                 offsets=offsets)
+    mesh = data_parallel_mesh(8)
+    cb = build_chunked_batch(rows, d, labels, weights=weights,
+                             offsets=offsets, n_chunks=2, layout="ell",
+                             mesh=mesh)
+    cobj = ChunkedGLMObjective(obj, cb, max_resident=2)
+    w = jnp.asarray(rng.normal(0, 0.2, d), jnp.float32)
+    f_r, g_r = obj.value_and_gradient(w, resident)
+    f_c, g_c = cobj.value_and_gradient(w)
+    np.testing.assert_allclose(float(f_c), float(f_r), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(g_c), np.asarray(g_r),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        cobj.x_dot(w),
+        np.asarray(resident.x_dot(w))[: cb.n],
+        rtol=2e-4, atol=2e-4)
+
+
+def test_estimator_chunked_fit_matches_resident(rng):
+    """GameEstimator with chunk_rows ≡ the resident estimator (fixed
+    effect + random effect CD, scoring through the transformer)."""
+    from photon_ml_tpu.config import (
+        CoordinateConfig,
+        CoordinateKind,
+        OptimizerSettings,
+        TrainingConfig,
+    )
+    from photon_ml_tpu.estimators.game_estimator import GameEstimator
+    from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+    from photon_ml_tpu.game.dataset import GameDataset
+    from photon_ml_tpu.models.glm import TaskType
+
+    n, d, k = 900, 120, 5
+    cols = np.stack([
+        np.sort(rng.choice(d, k, replace=False)) for _ in range(n)
+    ]).astype(np.int32)
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    ids = rng.integers(0, 12, n)
+    w_true = rng.normal(0, 1, d)
+    u_true = rng.normal(0, 1.0, 12)
+    m = np.einsum("nk,nk->n", vals, w_true[cols]) + u_true[ids]
+    y = (m + rng.normal(0, 0.3, n) > 0).astype(np.float32)
+    x_re = np.ones((n, 1), np.float32)
+    rows = [(cols[i], vals[i]) for i in range(n)]
+    ds = GameDataset(labels=y, features={"f": rows, "per_user": x_re},
+                     entity_ids={"user": ids}, feature_dims={"f": d})
+
+    def cfg(**kw):
+        return TrainingConfig(
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            coordinates=[
+                CoordinateConfig(
+                    name="global", kind=CoordinateKind.FIXED_EFFECT,
+                    feature_shard="f",
+                    optimizer=OptimizerSettings(max_iters=60,
+                                                reg_weight=1.0),
+                ),
+                CoordinateConfig(
+                    name="user", kind=CoordinateKind.RANDOM_EFFECT,
+                    feature_shard="per_user", entity_key="user",
+                    optimizer=OptimizerSettings(max_iters=40,
+                                                reg_weight=2.0),
+                ),
+            ],
+            update_sequence=["global", "user"],
+            n_iterations=2,
+            evaluators=[EvaluatorType.AUC],
+            validation_fraction=0.0,
+            validate_per_iteration=False,
+            intercept=False,
+            **kw,
+        )
+
+    from photon_ml_tpu.estimators.game_transformer import GameTransformer
+
+    fit_r = GameEstimator(cfg()).fit(ds)[0]
+    fit_c = GameEstimator(cfg(chunk_rows=256, chunk_layout="ELL",
+                              chunk_max_resident=8)).fit(ds)[0]
+    w_r = np.asarray(fit_r.model.models["global"].coefficients.means)
+    w_c = np.asarray(fit_c.model.models["global"].coefficients.means)
+    np.testing.assert_allclose(w_c, w_r, rtol=5e-3, atol=5e-3)
+    task = TaskType.LOGISTIC_REGRESSION
+    s_r = GameTransformer(model=fit_r.model, task=task).transform(ds)
+    s_c = GameTransformer(model=fit_c.model, task=task).transform(ds)
+    np.testing.assert_allclose(np.asarray(s_c), np.asarray(s_r),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_chunked_config_validation():
+    from photon_ml_tpu.config import (
+        CoordinateConfig,
+        CoordinateKind,
+        NormalizationType,
+        OptimizerSettings,
+        TrainingConfig,
+    )
+    from photon_ml_tpu.models.glm import TaskType
+
+    base = dict(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinates=[CoordinateConfig(
+            name="g", kind=CoordinateKind.FIXED_EFFECT,
+            feature_shard="f", optimizer=OptimizerSettings())],
+        update_sequence=["g"],
+    )
+    with pytest.raises(ValueError, match="chunk_rows"):
+        TrainingConfig(chunk_rows=0, **base).validate()
+    with pytest.raises(ValueError, match="normalization"):
+        TrainingConfig(chunk_rows=100,
+                       normalization=NormalizationType.STANDARDIZATION,
+                       **base).validate()

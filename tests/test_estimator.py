@@ -2,6 +2,7 @@
 (reference GameEstimatorIntegTest class of coverage, SURVEY.md §4)."""
 
 import numpy as np
+import pytest
 
 from photon_ml_tpu.config import (
     CoordinateConfig,
@@ -257,3 +258,32 @@ def test_track_states_in_run_log():
     assert len(states["grad_norms"]) == n_states
     # Monotone-ish: the final value must improve on the initial.
     assert states["values"][-1] < states["values"][0]
+
+
+@pytest.mark.fast
+def test_device_score_sparse_matches_host():
+    """The chunked device X·w used by GameTransformer for large sparse
+    inputs must equal the host numpy pass (round-4 verdict item #6)."""
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+    from photon_ml_tpu.estimators.game_transformer import (
+        _device_score_sparse,
+    )
+
+    rng = np.random.default_rng(4)
+    n, d, k = 5000, 700, 6
+    cols = np.stack([np.sort(rng.choice(d, k, replace=False))
+                     for _ in range(n)]).astype(np.int64)
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    indptr = np.arange(n + 1, dtype=np.int64) * k
+    rows = SparseRows.from_flat(indptr, cols.reshape(-1),
+                                vals.reshape(-1))
+    w = rng.normal(0, 1, d).astype(np.float32)
+    import photon_ml_tpu.estimators.game_transformer as gt
+    old = gt._DEVICE_SCORE_CHUNK
+    gt._DEVICE_SCORE_CHUNK = 1024   # force multi-chunk + padded tail
+    try:
+        out = _device_score_sparse(rows, w)
+    finally:
+        gt._DEVICE_SCORE_CHUNK = old
+    np.testing.assert_allclose(out, rows.dot_dense(w.astype(np.float64)),
+                               rtol=2e-4, atol=2e-4)

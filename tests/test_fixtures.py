@@ -1,0 +1,112 @@
+"""Byte-fixture parity: committed data files + golden models.
+
+Round-4 verdict item #7 / SURVEY §4 tier 3: the reference's integTests
+run against committed Avro fixtures with golden models and AUC
+thresholds.  Here parity is data-at-rest — the LIBSVM/Avro bytes in
+``tests/resources/`` are the contract (generated once by
+``make_fixtures.py``, committed), and training from those files must
+reproduce the recorded golden coefficients and AUC, not a re-derivation
+from seeds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+HERE = os.path.join(os.path.dirname(__file__), "resources")
+
+
+@pytest.fixture(scope="module")
+def golden():
+    with open(os.path.join(HERE, "golden.json")) as f:
+        return json.load(f)
+
+
+def test_config1_libsvm_fixture_parity(tmp_path, golden):
+    """BASELINE config-1 class from committed LIBSVM bytes."""
+    from photon_ml_tpu.cli import game_training_driver
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    cfg = {
+        "task_type": "LOGISTIC_REGRESSION",
+        "coordinates": [{
+            "name": "global", "kind": "FIXED_EFFECT",
+            "feature_shard": "features",
+            "optimizer": {"optimizer": "LBFGS", "reg_weight": 1.0,
+                          "max_iters": 100},
+        }],
+        "update_sequence": ["global"],
+        "input_path": os.path.join(HERE, "config1.libsvm"),
+        "validation_path": os.path.join(HERE, "config1.t.libsvm"),
+        "output_dir": str(tmp_path / "out"),
+        "evaluators": ["AUC"],
+    }
+    p = str(tmp_path / "cfg.json")
+    json.dump(cfg, open(p, "w"))
+    summary = game_training_driver.main(["--config", p])
+    want = golden["config1"]
+    got_auc = summary["models"][0]["evaluations"]["AUC"]
+    assert abs(got_auc - want["auc"]) < 2e-3, (got_auc, want["auc"])
+    model, _ = load_game_model(str(tmp_path / "out" / "model"))
+    w = np.asarray(model.models["global"].coefficients.means)
+    np.testing.assert_allclose(w, np.asarray(want["coefficients"]),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_config4_avro_fixture_parity(tmp_path, golden):
+    """BASELINE config-4 class (fixed + per-user RE) from committed
+    Avro container bytes — exercises the from-spec Avro reader on
+    data-at-rest."""
+    from photon_ml_tpu.cli import game_training_driver
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    cfg = {
+        "task_type": "LOGISTIC_REGRESSION",
+        "coordinates": [
+            {"name": "global", "kind": "FIXED_EFFECT",
+             "feature_shard": "global",
+             "optimizer": {"optimizer": "LBFGS", "reg_weight": 1.0,
+                           "max_iters": 100}},
+            {"name": "per_user", "kind": "RANDOM_EFFECT",
+             "feature_shard": "user_re", "entity_key": "userId",
+             "optimizer": {"optimizer": "LBFGS", "reg_weight": 2.0,
+                           "max_iters": 60}},
+        ],
+        "update_sequence": ["global", "per_user"],
+        "n_iterations": 2,
+        "input_path": os.path.join(HERE, "config4_train.avro"),
+        "validation_path": os.path.join(HERE, "config4_valid.avro"),
+        "output_dir": str(tmp_path / "out"),
+        "evaluators": ["AUC"],
+    }
+    p = str(tmp_path / "cfg.json")
+    json.dump(cfg, open(p, "w"))
+    summary = game_training_driver.main(["--config", p])
+    want = golden["config4"]
+    got_auc = summary["models"][0]["evaluations"]["AUC"]
+    assert abs(got_auc - want["auc"]) < 2e-3, (got_auc, want["auc"])
+    model, _ = load_game_model(str(tmp_path / "out" / "model"))
+    w = np.asarray(model.models["global"].coefficients.means)
+    np.testing.assert_allclose(
+        w, np.asarray(want["fixed_coefficients"]), rtol=2e-3, atol=2e-3)
+
+
+def test_fixture_bytes_are_stable():
+    """The committed files ARE the contract: catch accidental
+    regeneration/corruption by size+checksum (sync markers make Avro
+    bytes random per write, so a silent regen would change these)."""
+    import hashlib
+
+    sizes = {}
+    for name in ("config1.libsvm", "config1.t.libsvm",
+                 "config4_train.avro", "config4_valid.avro"):
+        with open(os.path.join(HERE, name), "rb") as f:
+            raw = f.read()
+        sizes[name] = (len(raw), hashlib.sha256(raw).hexdigest()[:16])
+    with open(os.path.join(HERE, "checksums.json")) as f:
+        want = {k: tuple(v) for k, v in json.load(f).items()}
+    assert sizes == want
