@@ -62,6 +62,10 @@ def _grr_stream_bytes(pair) -> int:
     windows, and the dense hot side."""
 
     def direction_bytes(d_) -> int:
+        from photon_ml_tpu.data.grr import GrrRangeSplit
+
+        if isinstance(d_, GrrRangeSplit):
+            return sum(direction_bytes(p) for p in d_.parts)
         slots = d_.n_supertiles * 16384
         b = slots * (4 + 3)                           # vals + g1/g2/g3
         b += d_.n_spill * 12                          # spill idx/seg/val

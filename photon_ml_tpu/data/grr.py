@@ -869,6 +869,16 @@ def _grr_tdot(pair: GrrPair, r: Array) -> Array:
     return f(r)
 
 
+def _range_overflow_threshold(overflow_threshold: int,
+                              frac: float) -> int:
+    """Per-range overflow threshold: scales with the range's mass
+    fraction (the global floor would leave a mid-size range's spill on
+    the COO scatter) with a floor below which a level-2 plan can't pay
+    for itself.  Single source for the resident AND sharded builders —
+    their spill economics must not drift apart (review finding)."""
+    return max(4096, int(overflow_threshold * frac))
+
+
 def _plan_col_ranges(cols, vals_masked, dim, max_parts=4,
                      sample_rows=65536):
     """Window-aligned contiguous column ranges of roughly homogeneous
@@ -1089,11 +1099,7 @@ def build_grr_pair(
         if ranges:
             parts = []
             for lo, hi, frac in ranges:
-                # Overflow threshold scales with the part's mass: the
-                # global floor would leave a mid-size part's spill on
-                # the COO scatter (the economy bounds in
-                # _spill_overflow still protect tiny tails).
-                thr = max(4096, int(overflow_threshold * frac))
+                thr = _range_overflow_threshold(overflow_threshold, frac)
                 parts.append(_build_direction_ell(
                     cols, vals_masked, 0, dim, n, cap, validate,
                     thr, device=False, idx_range=(lo, hi)))
@@ -1317,6 +1323,7 @@ def build_sharded_grr_pairs(
     mid_threshold: int | None = None,
     validate: bool = True,
     overflow_threshold: int | None = None,
+    col_range_split: bool | None = None,
 ) -> list[GrrPair]:
     """Compile per-shard GRR plans over equal-size row shards.
 
@@ -1325,6 +1332,10 @@ def build_sharded_grr_pairs(
     shard with HOST (numpy) leaves and identical pytree structure +
     leaf shapes, ready for ``jax.make_array_from_single_device_arrays``
     assembly (``parallel.mesh.shard_sparse_batch(layout="grr")``).
+    ``col_range_split`` (default: auto, on for shards ≥ one row window)
+    splits every shard's row direction into the SAME per-capacity
+    column ranges under skewed column popularity (``GrrRangeSplit``),
+    decided on a pooled cross-shard sample.
     """
     n_shards = len(shard_cols)
     per = shard_cols[0].shape[0]
@@ -1398,37 +1409,91 @@ def build_sharded_grr_pairs(
             mid_dirs[i] = md
             tails[i] = tail
 
+    # Column-range split for the row direction (``GrrRangeSplit``):
+    # decided ONCE on a pooled cross-shard sample so every shard splits
+    # into the same ranges (congruence), with per-range caps/dense
+    # flags forced common across shards like every other shared choice.
+    row_ranges = None
+    if col_range_split or (col_range_split is None and per >= WIN):
+        samp_per = max(1, 65536 // n_shards)
+        stride = max(1, per // samp_per)
+        samp_c = np.concatenate(
+            [c[::stride][:samp_per] for (c, _, _) in prepped])
+        samp_v = np.concatenate(
+            [vm[::stride][:samp_per] for (_, _, vm) in prepped])
+        row_ranges = _plan_col_ranges(samp_c, samp_v, dim,
+                                      sample_rows=samp_c.shape[0])
+        if row_ranges:
+            logger.info(
+                "sharded GRR row direction: column-range split into %d "
+                "parts (bounds %s)", len(row_ranges),
+                [lo for lo, _, _ in row_ranges] + [dim])
+
     # Pass 3: main directions per shard, heaviest shard first — the
     # shared cap/dense-grid choice is seeded by the shard with the most
     # nonzeros, matching the Pass 2 rationale (advisor finding: seeding
     # from shard 0 in index order lets an unrepresentative shard pick a
     # too-small cap and push other shards' mass into spill/overflow).
-    row_dirs = [None] * n_shards
-    col_dirs = [None] * n_shards
+    row_dirs: list = [None] * n_shards
+    col_dirs: list = [None] * n_shards
     x_hots = [x_hot for (_, x_hot, _) in prepped]
     nnzs = [int(np.count_nonzero(vm)) for (_, _, vm) in prepped]
+    n_parts = len(row_ranges) if row_ranges else 0
+    row_parts: list = [[None] * n_parts for _ in range(n_shards)]
+    part_caps = [cap] * n_parts
+    part_dense: list = [None] * n_parts
     row_cap, col_cap = cap, cap
     row_dense = col_dense = None
     for i in sorted(range(n_shards), key=lambda j: -nnzs[j]):
         c, _, vm = prepped[i]
         vm_tail = tails[i] if tails[i] is not None else vm
-        rd = _build_direction_ell(c, vm, 0, dim, per, row_cap, validate,
-                                  None, device=False, dense_grid=row_dense)
-        row_cap = row_cap or rd.cap
-        row_dense = rd.dense_grid if row_dense is None else row_dense
+        if row_ranges:
+            for r, (lo, hi, _) in enumerate(row_ranges):
+                p = _build_direction_ell(
+                    c, vm, 0, dim, per, part_caps[r], validate, None,
+                    device=False, dense_grid=part_dense[r],
+                    idx_range=(lo, hi))
+                part_caps[r] = part_caps[r] or p.cap
+                part_dense[r] = (p.dense_grid if part_dense[r] is None
+                                 else part_dense[r])
+                row_parts[i][r] = p
+        else:
+            rd = _build_direction_ell(c, vm, 0, dim, per, row_cap,
+                                      validate, None, device=False,
+                                      dense_grid=row_dense)
+            row_cap = row_cap or rd.cap
+            row_dense = rd.dense_grid if row_dense is None else row_dense
+            row_dirs[i] = rd
         cd_ = _build_direction_ell(c, vm_tail, 1, per, dim, col_cap,
                                    validate, None, device=False,
                                    dense_grid=col_dense)
         col_cap = col_cap or cd_.cap
         col_dense = cd_.dense_grid if col_dense is None else col_dense
-        row_dirs[i] = rd
         col_dirs[i] = cd_
 
-    row_dirs = _pool_overflow(row_dirs, dim, per, validate,
-                              overflow_threshold)
+    if row_ranges:
+        # Overflow pooling + padding happen PER RANGE across shards
+        # (each range is its own congruent plan family); the part-mass
+        # fraction scales its overflow threshold as in build_grr_pair.
+        bounds = tuple(lo for lo, _, _ in row_ranges) + (dim,)
+        for r, (lo, hi, frac) in enumerate(row_ranges):
+            fam = [row_parts[i][r] for i in range(n_shards)]
+            thr = _range_overflow_threshold(overflow_threshold, frac)
+            fam = _pool_overflow(fam, hi - lo, per, validate, thr)
+            fam = _pad_dirs_common(fam)
+            for i in range(n_shards):
+                row_parts[i][r] = fam[i]
+        row_dirs = [
+            GrrRangeSplit(parts=tuple(row_parts[i]), bounds=bounds,
+                          table_len=dim, n_segments=per)
+            for i in range(n_shards)
+        ]
+    else:
+        row_dirs = _pool_overflow(row_dirs, dim, per, validate,
+                                  overflow_threshold)
+        row_dirs = _pad_dirs_common(row_dirs)
     col_dirs = _pool_overflow(col_dirs, per, dim, validate,
                               overflow_threshold)
-    row_dirs = _pad_dirs_common(row_dirs)
     col_dirs = _pad_dirs_common(col_dirs)
     if mid_pos is not None:
         mid_dirs = _pool_overflow(mid_dirs, per, int(mid.size), validate,

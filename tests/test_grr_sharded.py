@@ -315,3 +315,53 @@ def test_sharded_pairs_multiwindow_zipf(rng):
         leaves, tdef = jax.tree.flatten(p)
         assert tdef == t0
         assert [lf.shape for lf in leaves] == s0
+
+
+def test_sharded_pairs_col_range_split(rng):
+    """Round-5: the column-range split engages on sharded builds too —
+    same ranges on every shard (pooled sample), per-range caps common,
+    overflow pooled and padded per range — and reproduces the global
+    plan's contraction."""
+    from photon_ml_tpu.data.grr import WIN, GrrRangeSplit
+
+    n, d, k, n_dev = 8 * WIN, 70_000, 16, 8
+    x0 = 5000.0
+    u = rng.uniform(size=(n, k))
+    cols = np.minimum(x0 * np.exp(u * np.log((d + x0) / x0)) - x0,
+                      d - 1).astype(np.int32)
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    per = n // n_dev
+    shard_c = [cols[i * per:(i + 1) * per] for i in range(n_dev)]
+    shard_v = [vals[i * per:(i + 1) * per] for i in range(n_dev)]
+    pairs = build_sharded_grr_pairs(shard_c, shard_v, d)
+    assert isinstance(pairs[0].row_dir, GrrRangeSplit)
+    bounds = pairs[0].row_dir.bounds
+    for p in pairs[1:]:
+        assert p.row_dir.bounds == bounds          # same ranges everywhere
+    caps0 = [q.cap for q in pairs[0].row_dir.parts]
+    for p in pairs[1:]:
+        assert [q.cap for q in p.row_dir.parts] == caps0
+    assert len(set(caps0)) >= 2                    # ranges chose own caps
+
+    unsplit = build_sharded_grr_pairs(shard_c, shard_v, d,
+                                      col_range_split=False)
+    s = pairs[0].row_dir.plan_stats()
+    su = unsplit[0].row_dir.plan_stats()
+    assert s["spill_frac"] < su["spill_frac"] / 3
+
+    ref = build_grr_pair(cols, vals, d, col_range_split=False)
+    w = rng.normal(0, 1, d).astype(np.float32)
+    got = np.concatenate([_pair_dot(p, w) for p in pairs])
+    np.testing.assert_allclose(got, _pair_dot(ref, w), rtol=2e-4,
+                               atol=5e-4)
+    r = rng.normal(0, 1, n).astype(np.float32)
+    got_g = sum(_pair_tdot(p, r[i * per:(i + 1) * per])
+                for i, p in enumerate(pairs))
+    np.testing.assert_allclose(got_g, _pair_tdot(ref, r), rtol=2e-4,
+                               atol=2e-3)
+    t0 = jax.tree.flatten(pairs[0])[1]
+    s0 = [lf.shape for lf in jax.tree.leaves(pairs[0])]
+    for p in pairs[1:]:
+        leaves, tdef = jax.tree.flatten(p)
+        assert tdef == t0
+        assert [lf.shape for lf in leaves] == s0
