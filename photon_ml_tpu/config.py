@@ -52,6 +52,14 @@ class OptimizerSettings:
     track_states: bool = False
 
     def validate(self) -> None:
+        # Coerce a raw-string variance_type to the enum ONCE, loudly
+        # rejecting typos — downstream checks (chunked FULL-variance
+        # guard, compute_variances dispatch) then compare enums, and an
+        # unknown string can't silently fall through to full_variances
+        # (review finding).
+        if not isinstance(self.variance_type, VarianceComputationType):
+            self.variance_type = VarianceComputationType(
+                str(self.variance_type).upper())
         if self.max_iters <= 0:
             raise ValueError("max_iters must be positive")
         if self.tolerance <= 0:
@@ -242,7 +250,8 @@ class TrainingConfig:
                         "down-sampling is not supported with chunked "
                         "training (chunk_rows)")
                 if (c.kind == CoordinateKind.FIXED_EFFECT
-                        and c.optimizer.variance_type.value == "FULL"):
+                        and c.optimizer.variance_type
+                        == VarianceComputationType.FULL):
                     raise ValueError(
                         "FULL variances materialize a [d, d] Hessian — "
                         "not supported with chunked training "

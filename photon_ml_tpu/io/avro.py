@@ -21,8 +21,9 @@ Resolution"): ``read_container(path, reader_schema=...)`` decodes with
 the container's embedded writer schema and resolves each datum to the
 caller's reader schema — writer-only fields are skipped, reader-only
 fields take their defaults, primitives promote (int→long→float→double,
-string↔bytes), unions resolve branch-wise — so files written by evolved
-reference pipelines stay readable.
+string↔bytes), unions resolve branch-wise, renamed fields/types match
+through reader aliases — so files written by evolved reference
+pipelines stay readable.
 
 This is host-side ETL: nothing here touches jax.  Device code only ever
 sees the int32/float32 arrays produced downstream (``io.dataset``).
@@ -372,13 +373,15 @@ def _decode_resolved(wschema: Schema, ws: Any, rschema: Schema, rs: Any,
         raise TypeError(
             f"cannot resolve writer {wt!r} to reader {rt!r}")
     if wt == rt and wt in ("enum", "fixed"):
-        # Spec: named types resolve only when (unqualified) names match;
-        # fixed additionally requires equal sizes.  A silent fall-
-        # through here would yield writer-shaped bytes under a reader
-        # contract that promises something else (review finding).
+        # Spec: named types resolve only when (unqualified) names match
+        # — or the reader declares the writer's name as an alias; fixed
+        # additionally requires equal sizes.  A silent fall-through
+        # here would yield writer-shaped bytes under a reader contract
+        # that promises something else (review finding).
         wn = ws["name"].rsplit(".", 1)[-1]
         rn = rs["name"].rsplit(".", 1)[-1]
-        if wn != rn:
+        if wn != rn and wn not in (
+                a.rsplit(".", 1)[-1] for a in rs.get("aliases", ())):
             raise TypeError(
                 f"{wt} name mismatch: writer {wn!r}, reader {rn!r}")
         if wt == "fixed" and ws["size"] != rs["size"]:
@@ -388,13 +391,22 @@ def _decode_resolved(wschema: Schema, ws: Any, rschema: Schema, rs: Any,
     if wt == "record":
         wn = ws["name"].rsplit(".", 1)[-1]
         rn = rs["name"].rsplit(".", 1)[-1]
-        if wn != rn:
+        if wn != rn and wn not in (
+                a.rsplit(".", 1)[-1] for a in rs.get("aliases", ())):
             raise TypeError(f"record name mismatch: writer {wn}, "
                             f"reader {rn}")
         r_fields = {f["name"]: f for f in rs["fields"]}
+        # Reader field aliases (spec §Aliases): a renamed field matches
+        # the writer data under its OLD name.
+        r_alias = {a: f for f in rs["fields"]
+                   for a in f.get("aliases", ())}
         out = {}
         for f in ws["fields"]:        # wire order = writer field order
             rf = r_fields.pop(f["name"], None)
+            if rf is None:
+                rf = r_alias.get(f["name"])
+                if rf is not None:
+                    r_fields.pop(rf["name"], None)
             if rf is None:
                 _skip(wschema, f["type"], inp)
             else:

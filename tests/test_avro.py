@@ -439,3 +439,26 @@ def test_resolution_fixed_size_mismatch_is_loud(tmp_path):
     _, recs = read_container(p, reader_schema=reader)
     with pytest.raises(TypeError, match="size mismatch"):
         list(recs)
+
+
+def test_resolution_aliases(tmp_path):
+    """Spec §Aliases: a reader that RENAMED a field (or a named type)
+    still reads writer data under the old name via aliases."""
+    from photon_ml_tpu.io.avro import read_container, write_container
+
+    writer = {"type": "record", "name": "Old", "fields": [
+        {"name": "score", "type": "double"},
+        {"name": "kind", "type": {"type": "enum", "name": "KindOld",
+                                  "symbols": ["A", "B"]}},
+    ]}
+    reader = {"type": "record", "name": "New", "aliases": ["Old"],
+              "fields": [
+        {"name": "value", "type": "double", "aliases": ["score"]},
+        {"name": "kind", "type": {"type": "enum", "name": "Kind",
+                                  "aliases": ["KindOld"],
+                                  "symbols": ["A", "B"]}},
+    ]}
+    p = str(tmp_path / "alias.avro")
+    write_container(p, writer, [{"score": 1.5, "kind": "B"}])
+    _, recs = read_container(p, reader_schema=reader)
+    assert list(recs) == [{"value": 1.5, "kind": "B"}]
