@@ -12,6 +12,10 @@ from photon_ml_tpu.optim.base import (
 )
 from photon_ml_tpu.optim.lbfgs import lbfgs_solve, owlqn_solve
 from photon_ml_tpu.optim.problem import OptimizationProblem, solve_batched
+from photon_ml_tpu.optim.streaming import (
+    ChunkedGLMObjective,
+    streaming_lbfgs_solve,
+)
 from photon_ml_tpu.optim.tron import tron_solve
 
 __all__ = [
@@ -24,4 +28,6 @@ __all__ = [
     "tron_solve",
     "OptimizationProblem",
     "solve_batched",
+    "ChunkedGLMObjective",
+    "streaming_lbfgs_solve",
 ]
