@@ -1,0 +1,72 @@
+"""JAX persistent compilation cache wiring.
+
+The scale run pays ~1000 s of one-time compile+transfer and the
+scoring sweep another 1037 s (PERF.md) — both re-paid on every run for
+identical program shapes.  JAX ships a persistent compilation cache
+(``jax_compilation_cache_dir``) that keys compiled executables by
+(HLO, compile options, backend); enabling it makes those costs
+once-per-program-shape instead of once-per-run.
+
+``enable_compilation_cache`` is the single switch the drivers, the
+estimator, and the bench all call.  It is idempotent, resolves its
+default from ``PHOTON_ML_TPU_COMPILE_CACHE``, and degrades to a no-op
+on JAX builds without the knobs — a cache must never be able to make a
+run fail.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "PHOTON_ML_TPU_COMPILE_CACHE"
+
+_enabled_dir: str | None = None
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    ``cache_dir`` None falls back to ``$PHOTON_ML_TPU_COMPILE_CACHE``;
+    if that is unset too, this is a no-op (returns None).  Compiled
+    programs land under ``<cache_dir>/xla``.  The min-compile-time
+    floor is dropped to 0.5 s so the solver/scoring programs (seconds
+    to minutes of XLA time each) all persist without caching the
+    dispatch-layer trivia.  Returns the directory in effect."""
+    global _enabled_dir
+    cache_dir = cache_dir or os.environ.get(ENV_VAR)
+    if not cache_dir:
+        return None
+    xla_dir = os.path.join(os.path.abspath(cache_dir), "xla")
+    if _enabled_dir == xla_dir:
+        return xla_dir
+    try:
+        import jax
+
+        os.makedirs(xla_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", xla_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # Some jax builds latch the cache state at the FIRST compile and
+        # ignore later config changes; dropping the latched state makes
+        # the next compile re-read the directory we just set.  Clears
+        # only the persistent-cache handle, not the in-process jit cache.
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+    except Exception as e:  # older jax / read-only fs: run uncached
+        logger.warning(
+            "persistent compilation cache unavailable (%r); compiles "
+            "will not persist across runs", e)
+        return None
+    _enabled_dir = xla_dir
+    logger.info("persistent compilation cache at %s", xla_dir)
+    return xla_dir

@@ -1,0 +1,232 @@
+"""On-disk GRR plan cache: serialize compiled plans, keyed by content.
+
+A compiled plan (``GrrPair`` / ``GrrDirection`` / ``GrrRangeSplit`` /
+the sharded builder's list of pairs) is a pure function of the ELL
+arrays, the table width, and the plan-affecting build options — so the
+cache key is exactly that: a content fingerprint of (cols, vals, dim)
+× a config key × the planner version.  Loading a hit replaces the
+whole host build (the 123 s measured at the bench shape) with one
+``np.load`` + device transfer.
+
+Format: one uncompressed ``.npz`` per plan (arrays dominate — i8 route
+planes and f32 value streams compress poorly and slowly) holding every
+array leaf under a tree-path key, plus a JSON manifest (``__meta__``)
+that records the node structure and static fields.  Writes go to a
+``.tmp`` sibling and ``os.replace`` into place, so readers never see a
+partial file; any load failure (truncated zip, missing keys, manifest
+drift) returns None and the caller rebuilds — a cache must never be
+able to make a run fail.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import tempfile
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# Serialization-format version: bump when the on-disk layout changes.
+FORMAT_VERSION = 1
+
+_DIR_ARRAYS = ("g1", "g2", "g3", "vals", "gw_of_st", "ow_of_st",
+               "first_of_ow", "spill_idx", "spill_seg", "spill_val")
+_DIR_STATIC = ("table_len", "n_segments", "cap", "n_gw", "n_ow",
+               "dense_grid")
+
+
+# ---------------------------------------------------------------------------
+# Keys
+# ---------------------------------------------------------------------------
+
+
+def dataset_fingerprint(cols, vals, dim: int, extra: tuple = ()) -> str:
+    """Content hash of the exact plan inputs.
+
+    Hashes raw bytes (blake2b streams ~1 GB/s — sub-second at the bench
+    shape, negligible against the build it replaces); shapes and dtypes
+    are folded in so a reshape/retype can't collide.  ``extra`` lets
+    callers fold in more arrays (per-shard inputs)."""
+    h = hashlib.blake2b(digest_size=16)
+    for a in (cols, vals) + tuple(extra):
+        a = np.ascontiguousarray(a)
+        h.update(str((a.shape, a.dtype.str)).encode())
+        h.update(a.tobytes())
+    h.update(str(int(dim)).encode())
+    return h.hexdigest()
+
+
+def plan_config_key(**options) -> str:
+    """Hash of the plan-affecting build options (None-valued options
+    included: the auto heuristics ARE part of plan semantics)."""
+    blob = json.dumps({k: options[k] for k in sorted(options)},
+                      sort_keys=True, default=str)
+    return hashlib.blake2b(blob.encode(), digest_size=6).hexdigest()
+
+
+def plan_cache_path(cache_dir: str, fingerprint: str,
+                    config_key: str) -> str:
+    """File path for a (dataset, config) plan under ``cache_dir``.
+
+    The planner/builder version rides in the NAME (not the manifest) so
+    a version bump is a clean miss — stale entries are never opened."""
+    from photon_ml_tpu.data.grr import PLANNER_VERSION
+
+    return os.path.join(
+        cache_dir, "plans",
+        f"grr-{fingerprint}-{config_key}"
+        f"-v{FORMAT_VERSION}.{PLANNER_VERSION}.npz")
+
+
+# ---------------------------------------------------------------------------
+# Encode / decode
+# ---------------------------------------------------------------------------
+
+
+def _encode_node(node, prefix: str, arrays: dict):
+    """Plan node → manifest fragment; array leaves land in ``arrays``
+    as host numpy under tree-path keys."""
+    from photon_ml_tpu.data.grr import GrrDirection, GrrPair, GrrRangeSplit
+
+    if node is None:
+        return None
+    if isinstance(node, GrrDirection):
+        for f in _DIR_ARRAYS:
+            arrays[prefix + f] = np.asarray(getattr(node, f))
+        meta = {"kind": "dir"}
+        meta.update({f: getattr(node, f) for f in _DIR_STATIC})
+        meta["overflow"] = _encode_node(node.overflow, prefix + "o.",
+                                        arrays)
+        return meta
+    if isinstance(node, GrrRangeSplit):
+        return {
+            "kind": "split",
+            "bounds": list(node.bounds),
+            "table_len": node.table_len,
+            "n_segments": node.n_segments,
+            "parts": [_encode_node(p, f"{prefix}p{i}.", arrays)
+                      for i, p in enumerate(node.parts)],
+        }
+    if isinstance(node, GrrPair):
+        arrays[prefix + "hot_ids"] = np.asarray(node.hot_ids)
+        arrays[prefix + "x_hot"] = np.asarray(node.x_hot)
+        if node.mid_ids is not None:
+            arrays[prefix + "mid_ids"] = np.asarray(node.mid_ids)
+        return {
+            "kind": "pair",
+            "row": _encode_node(node.row_dir, prefix + "r.", arrays),
+            "col": _encode_node(node.col_dir, prefix + "c.", arrays),
+            "mid": _encode_node(node.col_mid, prefix + "m.", arrays),
+            "has_mid_ids": node.mid_ids is not None,
+        }
+    raise TypeError(f"cannot serialize plan node {type(node)!r}")
+
+
+def _decode_node(meta, prefix: str, arrays, place=None):
+    """``arrays`` is dict-like and read LAZILY (an open NpzFile during
+    load) — with ``place`` (e.g. ``jax.device_put``) each direction is
+    handed off the moment its arrays are decoded, so the async
+    host→device transfer of one direction overlaps the disk read of
+    the next.  The overflow chain rides inside its top-level direction
+    (placed as one subtree)."""
+    from photon_ml_tpu.data.grr import GrrDirection, GrrPair, GrrRangeSplit
+
+    if meta is None:
+        return None
+    kind = meta["kind"]
+    if kind == "dir":
+        kw = {f: arrays[prefix + f] for f in _DIR_ARRAYS}
+        kw.update({f: meta[f] for f in _DIR_STATIC})
+        kw["overflow"] = _decode_node(meta["overflow"], prefix + "o.",
+                                      arrays)
+        d = GrrDirection(**kw)
+        return place(d) if place is not None else d
+    if kind == "split":
+        return GrrRangeSplit(
+            parts=tuple(_decode_node(p, f"{prefix}p{i}.", arrays, place)
+                        for i, p in enumerate(meta["parts"])),
+            bounds=tuple(meta["bounds"]),
+            table_len=meta["table_len"],
+            n_segments=meta["n_segments"],
+        )
+    if kind == "pair":
+        return GrrPair(
+            row_dir=_decode_node(meta["row"], prefix + "r.", arrays,
+                                 place),
+            col_dir=_decode_node(meta["col"], prefix + "c.", arrays,
+                                 place),
+            hot_ids=arrays[prefix + "hot_ids"],
+            x_hot=arrays[prefix + "x_hot"],
+            mid_ids=(arrays[prefix + "mid_ids"]
+                     if meta["has_mid_ids"] else None),
+            col_mid=_decode_node(meta["mid"], prefix + "m.", arrays,
+                                 place),
+        )
+    raise ValueError(f"unknown plan node kind {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# Save / load
+# ---------------------------------------------------------------------------
+
+
+def save_plan(path: str, plan) -> None:
+    """Serialize a plan (or list of plans — the sharded builder's
+    output) to ``path`` atomically.  Leaves must be host-reachable
+    (numpy or device arrays; device leaves are pulled back — the
+    in-repo builders save from their host copies, so no pull happens
+    on the production path)."""
+    arrays: dict = {}
+    if isinstance(plan, (list, tuple)):
+        meta = {"kind": "list",
+                "items": [_encode_node(p, f"s{i}.", arrays)
+                          for i, p in enumerate(plan)]}
+    else:
+        meta = _encode_node(plan, "", arrays)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, __meta__=np.frombuffer(
+                json.dumps(meta).encode(), np.uint8), **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_plan(path: str, place=None):
+    """Deserialize a plan from ``path``, or None when the file is
+    absent, truncated, or structurally stale — every failure mode
+    means "rebuild", never "crash".
+
+    Without ``place``, leaves are HOST numpy (the sharded builders'
+    contract).  With ``place`` (e.g. ``jax.device_put``), each
+    direction is placed AS IT IS DECODED, pipelining the disk read of
+    later directions under the async transfer of earlier ones — the
+    warm path's analog of the cold build's transfer/build overlap."""
+    if not os.path.exists(path):
+        return None
+    z = None
+    try:
+        z = np.load(path, allow_pickle=False)
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        if isinstance(meta, dict) and meta.get("kind") == "list":
+            return [_decode_node(m, f"s{i}.", z, place)
+                    for i, m in enumerate(meta["items"])]
+        return _decode_node(meta, "", z, place)
+    except Exception as e:  # corrupt/partial/stale: rebuild
+        logger.warning("plan cache: unreadable entry %s (%r); rebuilding",
+                       path, e)
+        return None
+    finally:
+        if z is not None:
+            z.close()

@@ -31,6 +31,12 @@ def run(input_path: str, output_dir: str,
         shards: list[str] | None = None,
         entity_keys: list[str] | None = None,
         log: RunLogger | None = None) -> dict:
+    # Indexing itself is host-only, but wire the compilation cache
+    # like the other drivers so $PHOTON_ML_TPU_COMPILE_CACHE covers any
+    # jax use behind the I/O layer uniformly.
+    from photon_ml_tpu.cache import enable_compilation_cache
+
+    enable_compilation_cache()
     log = log or RunLogger()
     with log.timed("build_index_maps", input=input_path):
         feature_maps, entity_maps = build_index_maps(

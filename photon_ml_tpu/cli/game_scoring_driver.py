@@ -75,6 +75,11 @@ def _read_data(config: ScoringConfig, model, log: RunLogger) -> GameDataset:
 
 
 def run(config: ScoringConfig, log: RunLogger | None = None) -> dict:
+    # Wire the persistent compilation cache before the scoring programs
+    # compile (the 1037 s sweep compile is once per program shape).
+    from photon_ml_tpu.cache import enable_compilation_cache
+
+    enable_compilation_cache(config.compilation_cache_dir)
     out_dir = os.path.dirname(os.path.abspath(config.output_path))
     os.makedirs(out_dir, exist_ok=True)
     if log is None:

@@ -195,6 +195,7 @@ def make_sparse_batch(
     col_capacity: int | None = None,
     grr: bool = False,
     keep_ell: bool = True,
+    cache_dir: str | None = None,
 ) -> SparseBatch:
     """Build a padded-ELL SparseBatch.
 
@@ -209,6 +210,9 @@ def make_sparse_batch(
         auto from the column-occupancy distribution).
       grr: compile the GRR plan (``data.grr``) — the fast TPU path for
         both contraction directions; supersedes ``col_major`` when set.
+      cache_dir: on-disk GRR plan cache directory (see
+        ``photon_ml_tpu.cache``) — a second build of the same data and
+        options loads the plan instead of re-deriving it.
       keep_ell: with ``grr``, whether the ELL arrays also go to device.
         The GRR plan serves every contraction, so the device ELL copy
         (8 bytes/nnz of HBM) is only needed by feature statistics /
@@ -263,7 +267,8 @@ def make_sparse_batch(
         if col_major and not grr
         else None
     )
-    pair = build_grr_pair(cols, vals, dim) if grr else None
+    pair = (build_grr_pair(cols, vals, dim, cache_dir=cache_dir)
+            if grr else None)
     if grr and not keep_ell:
         vals = np.zeros((n_out, 0), np.float32)
         cols = np.zeros((n_out, 0), np.int32)

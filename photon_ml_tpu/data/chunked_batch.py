@@ -127,6 +127,7 @@ def build_chunked_batch(
     mesh=None,
     row_capacity: int | None = None,
     drop_ell_with_grr: bool = True,
+    cache_dir: str | None = None,
 ) -> ChunkedBatch:
     """Compile a dataset into K congruent host chunk batches.
 
@@ -140,6 +141,9 @@ def build_chunked_batch(
     the mesh path uses (chunks are shards of the example axis either
     way); hot/mid column sets and capacities are global across chunks,
     so one compiled contraction program serves every chunk.
+    ``cache_dir`` enables the on-disk plan cache for those chunk plans
+    (``photon_ml_tpu.cache``): the scale run's plan compile is paid
+    once per dataset, not once per run.
     """
     from photon_ml_tpu.data.sparse_rows import SparseRows
 
@@ -193,6 +197,7 @@ def build_chunked_batch(
             [c for c, _, _ in pieces_arr],
             [v for _, v, _ in pieces_arr],
             dim,
+            cache_dir=cache_dir,
         )
 
     pieces = []

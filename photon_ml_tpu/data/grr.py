@@ -58,6 +58,23 @@ WIN = 16384          # table entries per gather window ([128,128] VMEM tile)
 TILE = 128
 SLOTS = TILE * TILE  # nonzero slots per supertile
 
+# Planner/builder semantics version: part of every plan-cache key
+# (photon_ml_tpu.cache.plan_cache), so cached plans from an older
+# planner are clean misses.  Bump on ANY change that alters the plan a
+# given (cols, vals, dim, options) input compiles to — capacity
+# heuristics, range planning, routing, overflow economics.
+PLANNER_VERSION = 1
+
+# Default on-disk plan cache location (build_grr_pair /
+# build_sharded_grr_pairs ``cache_dir=None`` resolves through this).
+PLAN_CACHE_ENV = "PHOTON_ML_TPU_PLAN_CACHE"
+
+
+def _resolve_cache_dir(cache_dir: "str | None") -> "str | None":
+    import os
+
+    return cache_dir or os.environ.get(PLAN_CACHE_ENV) or None
+
 
 def _next_pow2(x: int) -> int:
     return 1 << max(0, int(x - 1).bit_length())
@@ -1017,6 +1034,48 @@ def _mid_hot_split(cols, vals_masked, dim, n, mid_threshold, validate,
 last_build_phases: dict = {}
 
 
+def _pair_cache_path(cols, vals, dim, cache_dir, config: dict,
+                     extra: tuple = ()) -> str:
+    """Plan-cache file path for these exact inputs (see
+    ``photon_ml_tpu.cache.plan_cache``).  The config key hashes the
+    PASSED option values (None = "auto") — the auto heuristics are
+    deterministic functions of the data, so keying the raw arguments
+    is exact; ``validate`` is excluded (it never changes the plan).
+    ``vals`` is fingerprinted through the same float32 cast the build
+    applies, so a caller holding float64 values resolves the same path
+    the build will actually read/write."""
+    from photon_ml_tpu.cache import plan_cache
+
+    fp = plan_cache.dataset_fingerprint(
+        np.asarray(cols), np.asarray(vals, np.float32), dim, extra=extra)
+    return plan_cache.plan_cache_path(
+        cache_dir, fp, plan_cache.plan_config_key(**config))
+
+
+# The build_grr_pair options that are part of plan semantics (and so of
+# the cache key); ``validate`` is excluded — it never changes the plan.
+_PLAN_OPTION_NAMES = ("cap", "hot_threshold", "max_hot", "max_hot_bytes",
+                      "mid_threshold", "overflow_threshold",
+                      "col_range_split")
+
+
+def pair_cache_path_for(cols, vals, dim, cache_dir: str,
+                        **overrides) -> str:
+    """The cache-file path ``build_grr_pair(cols, vals, dim,
+    **overrides)`` would read/write.  Option defaults are resolved from
+    ``build_grr_pair``'s own signature, so external callers (the bench)
+    never hold a copy that can drift out of sync with it."""
+    import inspect
+
+    sig = inspect.signature(build_grr_pair)
+    config = {n: sig.parameters[n].default for n in _PLAN_OPTION_NAMES}
+    unknown = set(overrides) - set(config)
+    if unknown:
+        raise TypeError(f"unknown plan options: {sorted(unknown)}")
+    config.update(overrides)
+    return _pair_cache_path(cols, vals, dim, cache_dir, config)
+
+
 def build_grr_pair(
     cols: np.ndarray,
     vals: np.ndarray,
@@ -1029,6 +1088,8 @@ def build_grr_pair(
     validate: bool = True,
     overflow_threshold: int | None = None,
     col_range_split: bool | None = None,
+    cache_dir: str | None = None,
+    cache_rebuild: bool = False,
 ) -> GrrPair:
     """Compile an ELL batch ([n,k] cols/vals) into the full GRR plan.
 
@@ -1046,6 +1107,14 @@ def build_grr_pair(
     row direction's table axis into per-capacity column ranges under
     skewed column popularity (``GrrRangeSplit``); uniform data keeps
     the single global plan either way.
+
+    ``cache_dir`` (default ``$PHOTON_ML_TPU_PLAN_CACHE``) enables the
+    on-disk plan cache: a hit replaces the whole host build with one
+    load + device transfer (the warm path); a miss builds as usual and
+    persists the host plan for the next run.  Phase timings in
+    ``last_build_phases`` record which path ran (``cache_hit``).
+    ``cache_rebuild`` skips the cache READ but still saves — how the
+    bench keeps its cold-ETL number honest while warming the cache.
     """
     import time as _time
 
@@ -1054,6 +1123,37 @@ def build_grr_pair(
     n, k = cols.shape
     phases: dict = {}
     _t0 = _time.perf_counter()
+    global last_build_phases
+
+    cache_dir = _resolve_cache_dir(cache_dir)
+    cache_path = None
+    if cache_dir is not None:
+        _passed = locals()
+        cache_path = _pair_cache_path(
+            cols, vals, dim, cache_dir,
+            {name: _passed[name] for name in _PLAN_OPTION_NAMES})
+        phases["cache_lookup_s"] = _time.perf_counter() - _t0
+        from photon_ml_tpu.cache import plan_cache
+
+        t0 = _time.perf_counter()
+        # place=device_put pipelines the disk read of later directions
+        # under the async transfer of earlier ones.
+        cached = (None if cache_rebuild
+                  else plan_cache.load_plan(cache_path,
+                                            place=jax.device_put))
+        if cached is not None:
+            phases["cache_hit"] = 1.0
+            phases["cache_load_s"] = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            pair = jax.device_put(cached)   # remaining host leaves
+            jax.block_until_ready(pair)
+            phases["transfer_fence_s"] = _time.perf_counter() - t0
+            phases["total_s"] = _time.perf_counter() - _t0
+            last_build_phases = phases
+            logger.info("GRR plan cache hit: %s", cache_path)
+            return pair
+        phases["cache_hit"] = 0.0
+
     if overflow_threshold is None:
         overflow_threshold = 16384 + int(np.count_nonzero(vals)) // 256
     n_row_windows = max(1, -(-n // WIN))
@@ -1073,57 +1173,41 @@ def build_grr_pair(
     auto_mid = mid_threshold is None
     if auto_mid:
         mid_threshold = 16 * n_row_windows
-    # Fast path: the native C++ builder consumes the ELL arrays
-    # directly (hot entries zeroed = dropped), streaming passes with
-    # cache-local counters instead of numpy full-array sorts.  Each
-    # direction falls back independently (the directions are built
-    # independently either way).  The row direction keeps mid entries
-    # (rows group them like any others); only the gradient direction
-    # excludes them.  The two chains — row plan vs (mid split → tail
-    # col plan) — share no state, so they run in two threads: the C++
-    # builder and numpy release the GIL, so on a real multi-core TPU
-    # host the plan compile halves (ROUND-3 verdict item; this build
-    # box has one core, where it is measured neutral).  Each chain
-    # device_puts its finished plan ASYNCHRONOUSLY (PJRT copies in the
-    # background) so one direction's host→HBM transfer overlaps the
-    # other direction's host build; the final fence is timed separately
-    # (``last_build_phases``).
+    # Pipelined build: every independent host build — one task per row
+    # range (or the single row plan) plus the (mid split → tail col)
+    # chain — runs through ONE shared thread pool.  The C++ builder and
+    # numpy release the GIL, so a multi-core TPU host builds all tasks
+    # concurrently, targeting wall-clock ≈ one scan (this 1-core build
+    # box is measured neutral).  Each task device_puts its OWN finished
+    # plan immediately (PJRT copies asynchronously in the background),
+    # so host→HBM transfers overlap the remaining host builds — the
+    # mid plan's transfer starts before the tail col build finishes,
+    # and early row ranges transfer under late ones.  The final fence
+    # is timed separately (``last_build_phases``).
     from concurrent.futures import ThreadPoolExecutor
 
-    def row_chain():
-        t0 = _time.perf_counter()
-        split = (col_range_split if col_range_split is not None
-                 else n >= WIN)
-        ranges = (_plan_col_ranges(cols, vals_masked, dim)
-                  if split else None)
-        if ranges:
-            # Range builds are independent (own caps, own overflow) —
-            # run them in threads: the C++ builder releases the GIL, so
-            # a multi-core TPU host builds all ranges concurrently
-            # (this 1-core box is measured neutral, as with the
-            # row/col chains).
-            def build_part(rng_):
-                lo, hi, frac = rng_
-                thr = _range_overflow_threshold(overflow_threshold, frac)
-                return _build_direction_ell(
-                    cols, vals_masked, 0, dim, n, cap, validate,
-                    thr, device=False, idx_range=(lo, hi))
+    # Range planning is a sampled scan (fast) — run it up front so the
+    # task list is flat and the pool can be sized to it.
+    split = (col_range_split if col_range_split is not None
+             else n >= WIN)
+    ranges = (_plan_col_ranges(cols, vals_masked, dim)
+              if split else None)
 
-            with ThreadPoolExecutor(max_workers=len(ranges)) as pex:
-                parts = list(pex.map(build_part, ranges))
-            bounds = tuple(lo for lo, _, _ in ranges) + (ranges[-1][1],)
-            rd = GrrRangeSplit(parts=tuple(parts), bounds=bounds,
-                               table_len=dim, n_segments=n)
-            logger.info(
-                "GRR row direction: column-range split into %d parts "
-                "(bounds %s, caps %s)", len(parts), bounds,
-                [p.cap for p in parts])
-        else:
-            rd = _build_direction_ell(cols, vals_masked, 0, dim, n, cap,
-                                      validate, overflow_threshold,
-                                      device=False)
-        phases["row_build_s"] = _time.perf_counter() - t0
-        return jax.device_put(rd)
+    row_t0 = _time.perf_counter()
+
+    def row_part(rng_):
+        lo, hi, frac = rng_
+        thr = _range_overflow_threshold(overflow_threshold, frac)
+        p = _build_direction_ell(cols, vals_masked, 0, dim, n, cap,
+                                 validate, thr, device=False,
+                                 idx_range=(lo, hi))
+        return p, jax.device_put(p)
+
+    def row_single():
+        p = _build_direction_ell(cols, vals_masked, 0, dim, n, cap,
+                                 validate, overflow_threshold,
+                                 device=False)
+        return p, jax.device_put(p)
 
     def col_chain():
         # The auto heuristic skips the mid split below one full row
@@ -1133,37 +1217,77 @@ def build_grr_pair(
         # mid_threshold overrides (tests, tuned workloads).
         t0 = _time.perf_counter()
         if not auto_mid or n >= WIN:
-            mid_ids, col_mid, vals_tail = _mid_hot_split(
+            mid_ids_h, col_mid_h, vals_tail = _mid_hot_split(
                 cols, vals_masked, dim, n, mid_threshold, validate,
                 overflow_threshold, device=False)
         else:
-            mid_ids, col_mid, vals_tail = None, None, vals_masked
+            mid_ids_h, col_mid_h, vals_tail = None, None, vals_masked
+        # Transfer the mid plan under the tail col build.
+        mid_ids_d = (None if mid_ids_h is None
+                     else jax.device_put(mid_ids_h))
+        col_mid_d = (None if col_mid_h is None
+                     else jax.device_put(col_mid_h))
         phases["mid_split_s"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
-        col_dir = _build_direction_ell(cols, vals_tail, 1, n, dim, cap,
-                                       validate, overflow_threshold,
-                                       device=False)
+        col_h = _build_direction_ell(cols, vals_tail, 1, n, dim, cap,
+                                     validate, overflow_threshold,
+                                     device=False)
         phases["col_build_s"] = _time.perf_counter() - t0
-        return (None if mid_ids is None else jax.device_put(mid_ids),
-                None if col_mid is None else jax.device_put(col_mid),
-                jax.device_put(col_dir))
+        return ((mid_ids_h, col_mid_h, col_h),
+                (mid_ids_d, col_mid_d, jax.device_put(col_h)))
 
-    with ThreadPoolExecutor(max_workers=2) as ex:
-        f_row = ex.submit(row_chain)
+    n_row_tasks = len(ranges) if ranges else 1
+    with ThreadPoolExecutor(max_workers=n_row_tasks + 1) as ex:
         f_col = ex.submit(col_chain)
-        mid_ids, col_mid, col_dir = f_col.result()
-        row_dir = f_row.result()
+        if ranges:
+            row_futs = [ex.submit(row_part, r) for r in ranges]
+        else:
+            row_futs = [ex.submit(row_single)]
+        row_results = [f.result() for f in row_futs]
+        phases["row_build_s"] = _time.perf_counter() - row_t0
+        (mid_ids_h, col_mid_h, col_h), \
+            (mid_ids, col_mid, col_dir) = f_col.result()
+
+    if ranges:
+        bounds = tuple(lo for lo, _, _ in ranges) + (ranges[-1][1],)
+        row_h = GrrRangeSplit(
+            parts=tuple(p for p, _ in row_results), bounds=bounds,
+            table_len=dim, n_segments=n)
+        row_dir = GrrRangeSplit(
+            parts=tuple(d for _, d in row_results), bounds=bounds,
+            table_len=dim, n_segments=n)
+        logger.info(
+            "GRR row direction: column-range split into %d parts "
+            "(bounds %s, caps %s)", len(ranges), bounds,
+            [p.cap for p, _ in row_results])
+    else:
+        row_h, row_dir = row_results[0]
+
     pair = GrrPair(
         row_dir=row_dir, col_dir=col_dir,
         hot_ids=jnp.asarray(hot_ids), x_hot=jnp.asarray(x_hot),
         mid_ids=mid_ids,
         col_mid=col_mid,
     )
+    if cache_path is not None:
+        # Persist the HOST copy (no device pull-back) while the device
+        # transfers drain; failures only cost the next run its warm
+        # path, never this run.
+        t0 = _time.perf_counter()
+        try:
+            from photon_ml_tpu.cache import plan_cache
+
+            plan_cache.save_plan(cache_path, GrrPair(
+                row_dir=row_h, col_dir=col_h,
+                hot_ids=hot_ids, x_hot=x_hot,
+                mid_ids=mid_ids_h, col_mid=col_mid_h))
+        except Exception as e:  # never let the cache fail the run
+            logger.warning("plan cache: save failed (%r)", e)
+        phases["cache_save_s"] = _time.perf_counter() - t0
     t0 = _time.perf_counter()
     jax.block_until_ready(pair)
     phases["transfer_fence_s"] = _time.perf_counter() - t0
     phases["total_s"] = _time.perf_counter() - _t0
-    global last_build_phases
     last_build_phases = phases
     return pair
 
@@ -1332,6 +1456,7 @@ def build_sharded_grr_pairs(
     validate: bool = True,
     overflow_threshold: int | None = None,
     col_range_split: bool | None = None,
+    cache_dir: str | None = None,
 ) -> list[GrrPair]:
     """Compile per-shard GRR plans over equal-size row shards.
 
@@ -1344,8 +1469,28 @@ def build_sharded_grr_pairs(
     splits every shard's row direction into the SAME per-capacity
     column ranges under skewed column popularity (``GrrRangeSplit``),
     decided on a pooled cross-shard sample.
+
+    ``cache_dir`` (default ``$PHOTON_ML_TPU_PLAN_CACHE``): on-disk plan
+    cache over the whole shard list — the chunked builder's plans are
+    the scale path's biggest host cost, and the congruent list
+    round-trips as one entry (host leaves in, host leaves out).
     """
     n_shards = len(shard_cols)
+    cache_dir = _resolve_cache_dir(cache_dir)
+    cache_path = None
+    if cache_dir is not None:
+        from photon_ml_tpu.cache import plan_cache
+
+        _passed = locals()
+        config = {name: _passed[name] for name in _PLAN_OPTION_NAMES}
+        config.update({"n_shards": n_shards, "sharded": True})
+        cache_path = _pair_cache_path(
+            shard_cols[0], shard_vals[0], dim, cache_dir, config,
+            extra=tuple(shard_cols[1:]) + tuple(shard_vals[1:]))
+        cached = plan_cache.load_plan(cache_path)
+        if cached is not None:
+            logger.info("sharded GRR plan cache hit: %s", cache_path)
+            return cached
     per = shard_cols[0].shape[0]
     n_total = per * n_shards
     if overflow_threshold is None:   # nnz-scaled, as in build_grr_pair
@@ -1507,10 +1652,18 @@ def build_sharded_grr_pairs(
         mid_dirs = _pool_overflow(mid_dirs, per, int(mid.size), validate,
                                   overflow_threshold)
         mid_dirs = _pad_dirs_common(mid_dirs)
-    return [
+    pairs = [
         GrrPair(row_dir=rd, col_dir=cd_, hot_ids=hot_ids.copy(),
                 x_hot=xh,
                 mid_ids=None if mid_ids is None else mid_ids.copy(),
                 col_mid=md)
         for rd, cd_, xh, md in zip(row_dirs, col_dirs, x_hots, mid_dirs)
     ]
+    if cache_path is not None:
+        try:
+            from photon_ml_tpu.cache import plan_cache
+
+            plan_cache.save_plan(cache_path, pairs)
+        except Exception as e:  # never let the cache fail the run
+            logger.warning("plan cache: save failed (%r)", e)
+    return pairs

@@ -220,6 +220,7 @@ class GameEstimator:
                     rows, dim, labels, weights=weights,
                     chunk_rows=cfg.chunk_rows, layout=layout.lower(),
                     mesh=mesh,
+                    cache_dir=cfg.plan_cache_dir,
                 )
                 return {
                     "chunked": chunked, "batch": None,
@@ -244,6 +245,7 @@ class GameEstimator:
                 batch = shard_sparse_batch(
                     rows, dim, labels, mesh, weights=weights,
                     layout=layout.lower(),
+                    cache_dir=cfg.plan_cache_dir,
                 )
             else:
                 # Layout: the GRR compiled plan is the fast TPU path
@@ -267,6 +269,7 @@ class GameEstimator:
                     grr=(layout == "GRR"),
                     col_major=(layout == "COLMAJOR"),
                     keep_ell=keep_ell,
+                    cache_dir=cfg.plan_cache_dir,
                 )
 
         norm = NormalizationContext.identity()
@@ -662,6 +665,11 @@ class GameEstimator:
             validation: GameDataset | None = None,
             run_logger=None) -> list[FitResult]:
         """Train once per grid point; returns results in grid order."""
+        # Programmatic callers (no driver) still get the warm compile
+        # path from config; no-op when neither config nor env sets it.
+        from photon_ml_tpu.cache import enable_compilation_cache
+
+        enable_compilation_cache(self.config.compilation_cache_dir)
         prep = self._prepare(train)
         grid_points = self._grid_points()
         return [

@@ -77,25 +77,25 @@ def _call_validator(validator, coefs, total):
     changed with no shim, so an external caller's old validator would
     TypeError mid-training).  Arity is inspected up front — catching
     TypeError around the call would mask genuine TypeErrors raised
-    *inside* the validator.  The rule is REQUIRED positional count: a
-    validator with exactly one required positional parameter is treated
-    as legacy even if it carries optional extras (a legacy
-    ``(total_scores, sample_weight=None)`` must not get coefficients
-    bound to its scores argument); new-style validators should require
-    both parameters."""
+    *inside* the validator.  The rule is TOTAL positional count
+    (advisor finding: counting only REQUIRED positionals misclassified
+    a current-API ``(coefficients, total_scores=None)`` validator as
+    legacy and silently bound its coefficients to the scores slot):
+    a callable with two or more positional parameters is new-style
+    regardless of defaults; only an exactly-one-positional callable is
+    the legacy ``(total_scores)`` form."""
     import inspect
 
     try:
         params = list(inspect.signature(validator).parameters.values())
     except (TypeError, ValueError):  # builtins / C callables: assume new
         return validator(coefs, total)
-    required = [
+    positional = [
         p for p in params
         if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
-        and p.default is p.empty
     ]
     var_pos = any(p.kind is p.VAR_POSITIONAL for p in params)
-    if len(required) == 1 and not var_pos:
+    if len(positional) == 1 and not var_pos:
         return validator(total)
     return validator(coefs, total)
 

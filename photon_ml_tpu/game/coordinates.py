@@ -357,14 +357,31 @@ class ChunkedFixedEffectCoordinate(Coordinate):
     def initial_coefficients(self) -> Array:
         return jnp.zeros((self.chunked.dim,), jnp.float32)
 
+    def _coerce_offsets(self, offsets) -> np.ndarray:
+        """Offsets → exactly ``chunked.n`` entries.  Over-long arrays
+        are accepted ONLY when the length matches the known padding
+        grid (the chunk grid, which already folds in the mesh's device
+        rounding) — anything else is a caller bug that silent
+        truncation would turn into wrong training data (advisor
+        finding); under-long arrays fail in ``set_offsets``."""
+        off = np.asarray(offsets, np.float32)
+        n = self.chunked.n
+        if off.shape[0] == n:
+            return off
+        grid = self.chunked.n_chunks * self.chunked.chunk_rows
+        if off.shape[0] == grid:
+            return off[:n]
+        if off.shape[0] > n:
+            raise ValueError(
+                f"offsets length {off.shape[0]} exceeds n {n} and does "
+                f"not match the chunk padding grid {grid}")
+        return off
+
     def train(self, offsets: Array, warm_start: Array | None = None,
               donate_warm_start: bool = False):
         from photon_ml_tpu.optim.streaming import streaming_lbfgs_solve
 
-        off = np.asarray(offsets, np.float32)
-        if off.shape[0] != self.chunked.n:
-            off = off[: self.chunked.n]
-        self.chunked.set_offsets(off)
+        self.chunked.set_offsets(self._coerce_offsets(offsets))
         self._obj.invalidate()
         w0 = (self.initial_coefficients() if warm_start is None
               else warm_start)
@@ -396,7 +413,7 @@ class ChunkedFixedEffectCoordinate(Coordinate):
             raise ValueError(
                 "FULL variances materialize a [d, d] Hessian — not "
                 "supported on the chunked path; use SIMPLE")
-        self.chunked.set_offsets(np.asarray(offsets, np.float32))
+        self.chunked.set_offsets(self._coerce_offsets(offsets))
         self._obj.invalidate()
         diag = self._obj.hessian_diagonal(coefficients)
         return 1.0 / jnp.maximum(diag, 1e-12)

@@ -302,9 +302,15 @@ def _schemas_match(wschema: "Schema", ws: Any, rschema: "Schema",
     wt, rt = _type_of(ws), _type_of(rs)
     if wt == rt:
         if wt in ("record", "enum", "fixed"):
+            # Named types match on unqualified name — or when the
+            # reader declares the writer's name as an alias (spec
+            # §Aliases), mirroring _decode_resolved: without this a
+            # renamed type nested inside a reader union failed
+            # resolution that succeeds outside a union.
             wn = ws["name"].rsplit(".", 1)[-1]
             rn = rs["name"].rsplit(".", 1)[-1]
-            if wn != rn:
+            if wn != rn and wn not in (
+                    a.rsplit(".", 1)[-1] for a in rs.get("aliases", ())):
                 return False
             if wt == "fixed":
                 return ws["size"] == rs["size"]

@@ -42,6 +42,21 @@ from photon_ml_tpu.parallel.mesh import DATA_AXIS, batch_spec
 
 Array = jax.Array
 
+# jax >= 0.6 exposes shard_map at top level with the replication check
+# spelled ``check_vma``; older builds ship it under jax.experimental
+# with the same semantics as ``check_rep``.
+try:
+    from jax import shard_map as _shard_map_impl
+    _CHECK_KW = "check_vma"
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _CHECK_KW = "check_rep"
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **{_CHECK_KW: check_vma})
+
 
 def _vma(batch) -> bool:
     """Whether shard_map may validate varying-mesh-axes for this batch.
@@ -81,7 +96,7 @@ class DistributedGLMObjective:
         def local(w, batch):
             return jax.lax.psum(self._data_obj.value(w, batch), DATA_AXIS)
 
-        val = jax.shard_map(
+        val = _shard_map(
             local, mesh=self.mesh, in_specs=(P(), batch_spec()),
             out_specs=P(), check_vma=_vma(batch),
         )(w, batch)
@@ -92,7 +107,7 @@ class DistributedGLMObjective:
             v, g = self._data_obj.value_and_gradient(w, batch)
             return jax.lax.psum((v, g), DATA_AXIS)
 
-        v, g = jax.shard_map(
+        v, g = _shard_map(
             local, mesh=self.mesh, in_specs=(P(), batch_spec()),
             out_specs=(P(), P()), check_vma=_vma(batch),
         )(w, batch)
@@ -108,7 +123,7 @@ class DistributedGLMObjective:
                 self._data_obj.hessian_vector(w, v, batch), DATA_AXIS
             )
 
-        hv = jax.shard_map(
+        hv = _shard_map(
             local, mesh=self.mesh, in_specs=(P(), P(), batch_spec()),
             out_specs=P(), check_vma=_vma(batch),
         )(w, v, batch)
@@ -120,7 +135,7 @@ class DistributedGLMObjective:
                 self._data_obj.hessian_diagonal(w, batch), DATA_AXIS
             )
 
-        hd = jax.shard_map(
+        hd = _shard_map(
             local, mesh=self.mesh, in_specs=(P(), batch_spec()),
             out_specs=P(), check_vma=_vma(batch),
         )(w, batch)
@@ -128,7 +143,7 @@ class DistributedGLMObjective:
 
     # Scoring: no reduction — per-example outputs stay sharded in place.
     def predict_margins(self, w: Array, batch: Batch) -> Array:
-        return jax.shard_map(
+        return _shard_map(
             lambda w, b: self._data_obj.predict_margins(w, b),
             mesh=self.mesh, in_specs=(P(), batch_spec()),
             out_specs=batch_spec(), check_vma=_vma(batch),
@@ -138,7 +153,7 @@ class DistributedGLMObjective:
         """Raw X·v per example (coordinate scoring).  Must run under
         shard_map: a per-shard layout (GRR plan / colmajor) indexes only
         its device's rows, so the contraction is shard-local."""
-        return jax.shard_map(
+        return _shard_map(
             lambda v, b: b.x_dot(v),
             mesh=self.mesh, in_specs=(P(), batch_spec()),
             out_specs=batch_spec(), check_vma=_vma(batch),

@@ -122,6 +122,7 @@ def shard_sparse_batch(
     col_major: bool = True,
     col_capacity: int | None = None,
     layout: str | None = None,
+    cache_dir: str | None = None,
 ):
     """Host-side ETL: split examples across the mesh, build one
     SparseBatch per device — each with the fast-contraction layout of
@@ -142,6 +143,10 @@ def shard_sparse_batch(
     - ``"colmajor"`` (default, = ``col_major=True``) — per-shard
       transposed-ELL copies;
     - ``"ell"`` (= ``col_major=False``) — plain ELL shards.
+
+    ``cache_dir``: on-disk GRR plan cache (``photon_ml_tpu.cache``) for
+    the per-shard plans — the one-time "shuffle" becomes one-time per
+    DATASET, not per run.
     """
     from photon_ml_tpu.data.batch import make_sparse_batch
     from photon_ml_tpu.data.colmajor import build_colmajor, choose_capacity
@@ -224,6 +229,7 @@ def shard_sparse_batch(
             [np.asarray(b.col_ids) for b in shards],
             [np.asarray(b.values) for b in shards],
             dim,
+            cache_dir=cache_dir,
         )
         shards = [b.replace(grr=p) for b, p in zip(shards, pairs)]
 

@@ -462,3 +462,34 @@ def test_resolution_aliases(tmp_path):
     write_container(p, writer, [{"score": 1.5, "kind": "B"}])
     _, recs = read_container(p, reader_schema=reader)
     assert list(recs) == [{"value": 1.5, "kind": "B"}]
+
+
+def test_resolution_alias_named_type_inside_reader_union(tmp_path):
+    """A RENAMED named type nested inside a reader union resolves via
+    aliases (advisor finding: _schemas_match ignored reader aliases, so
+    the rename that works outside a union failed branch matching with
+    'matches no reader union branch')."""
+    from photon_ml_tpu.io.avro import read_container, write_container
+
+    writer = {"type": "record", "name": "Top", "fields": [
+        {"name": "inner", "type": {
+            "type": "record", "name": "OldInner", "fields": [
+                {"name": "x", "type": "long"},
+            ]}},
+        {"name": "tag", "type": {"type": "enum", "name": "OldTag",
+                                 "symbols": ["P", "Q"]}},
+    ]}
+    reader = {"type": "record", "name": "Top", "fields": [
+        {"name": "inner", "type": ["null", {
+            "type": "record", "name": "NewInner",
+            "aliases": ["OldInner"], "fields": [
+                {"name": "x", "type": "long"},
+            ]}]},
+        {"name": "tag", "type": ["null", {
+            "type": "enum", "name": "NewTag", "aliases": ["OldTag"],
+            "symbols": ["P", "Q"]}]},
+    ]}
+    p = str(tmp_path / "union_alias.avro")
+    write_container(p, writer, [{"inner": {"x": 7}, "tag": "Q"}])
+    _, recs = read_container(p, reader_schema=reader)
+    assert list(recs) == [{"inner": {"x": 7}, "tag": "Q"}]

@@ -1,0 +1,73 @@
+"""Bench budget contract: the driver's capture must never again be
+``rc: 124 / parsed: null`` (round-5 verdict).  These run the REAL
+bench.py as a subprocess on a tiny CPU shape, so a bench that outgrows
+its budget or breaks its JSON contract fails here — in the fast tier —
+instead of in the driver.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+_TINY = ["--n", "4096", "--d", "2048", "--k", "4"]
+
+
+def _run_bench(tmp_path, *args):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--cache-dir", str(tmp_path / "cache"),
+         *args],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    return proc
+
+
+@pytest.mark.fast
+def test_bench_etl_section_budgeted_json(tmp_path):
+    """`bench.py --section etl --budget-s 60` on a tiny shape: rc=0 and
+    the last stdout line parses as JSON with the ETL record."""
+    proc = _run_bench(tmp_path, "--section", "etl",
+                      "--budget-s", "60", *_TINY)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
+    rec = json.loads(lines[-1])
+    assert rec["section"] == "etl"
+    assert rec["etl_grr_s"] is not None
+    assert "etl_phases" in rec
+    assert rec.get("errors") is None
+    assert rec["sections_skipped"] == []
+
+
+def test_bench_cached_section_records_warm_vs_cold(tmp_path):
+    """etl + cached in one run: the cached section records the warm
+    load, the cold reference, the speedup ratio, and plan parity."""
+    proc = _run_bench(tmp_path, "--section", "etl,cached",
+                      "--budget-s", "120", *_TINY)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    cached = rec["cached"]
+    assert cached["etl_warm_s"] is not None
+    assert cached["etl_cold_s"] == rec["etl_grr_s"]
+    assert cached["warm_speedup"] is not None
+    assert cached["parity_ok"] is True
+
+
+def test_bench_zero_budget_still_emits_json(tmp_path):
+    """A hopeless budget skips every section but the process still
+    exits 0 with one parseable JSON line recording the skips."""
+    proc = _run_bench(tmp_path, "--budget-s", "0", *_TINY)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert set(rec["sections_skipped"]) == {
+        "etl", "cached", "grr", "segment_sum", "colmajor"}
+    assert rec["value"] is None

@@ -344,3 +344,46 @@ def test_estimator_chunked_warm_start_prior(rng, tmp_path):
     # SIMPLE variances computed through the chunked Hessian diagonal
     v_c = fit_c.model.models["global"].coefficients.variances
     assert v_c is not None and np.all(np.asarray(v_c) > 0)
+
+
+@pytest.mark.fast
+def test_chunked_offsets_padding_grid_rule(rng):
+    """Over-long offsets are accepted ONLY at the chunk padding grid
+    (advisor finding: unconditional off[:n] silently mistrained on a
+    genuinely mismatched caller); train and compute_variances share the
+    rule."""
+    from photon_ml_tpu.game.coordinates import ChunkedFixedEffectCoordinate
+    from photon_ml_tpu.optim.base import OptimizerType
+    from photon_ml_tpu.optim.variance import VarianceComputationType
+
+    rows, cols, vals, labels, weights, offsets = _sparse_problem(
+        rng, n=610, d=80, k=4)
+    cb = build_chunked_batch(rows, 80, labels, weights=weights,
+                             n_chunks=4, layout="ell")
+    coord = ChunkedFixedEffectCoordinate(
+        name="f", chunked=cb, objective=_objective(),
+        optimizer=OptimizerType.LBFGS,
+        config=OptimizerConfig(max_iters=2),
+    )
+    grid = cb.n_chunks * cb.chunk_rows
+    assert grid > cb.n  # the shape actually exercises padding
+
+    # Exact length and the padding grid both pass...
+    np.testing.assert_array_equal(
+        coord._coerce_offsets(np.zeros(cb.n, np.float32)),
+        np.zeros(cb.n, np.float32))
+    padded = np.arange(grid, dtype=np.float32)
+    np.testing.assert_array_equal(
+        coord._coerce_offsets(padded), padded[: cb.n])
+
+    # ...anything else over-long raises, in train AND compute_variances.
+    bad = np.zeros(cb.n + 7, np.float32)
+    with pytest.raises(ValueError, match="padding grid"):
+        coord.train(bad)
+    with pytest.raises(ValueError, match="padding grid"):
+        coord.compute_variances(
+            jnp.zeros(cb.dim, jnp.float32), bad,
+            VarianceComputationType.SIMPLE)
+    # Under-long still fails loudly downstream (set_offsets contract).
+    with pytest.raises(ValueError):
+        coord.train(np.zeros(cb.n - 3, np.float32))

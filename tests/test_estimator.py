@@ -287,3 +287,39 @@ def test_device_score_sparse_matches_host():
         gt._DEVICE_SCORE_CHUNK = old
     np.testing.assert_allclose(out, rows.dot_dense(w.astype(np.float64)),
                                rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.fast
+def test_device_score_chunk_grid_right_sized(monkeypatch):
+    """Small-but-device-eligible inputs compile a right-sized chunk
+    grid — min(n, _DEVICE_SCORE_CHUNK) rounded up to the 8192 tile —
+    instead of padding to the fixed 2M grid (advisor finding: ~8-10×
+    wasted gather/rowsum/transfer at n=250k)."""
+    import photon_ml_tpu.ops.kernels as kernels
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+    from photon_ml_tpu.estimators.game_transformer import (
+        _device_score_sparse,
+    )
+
+    rng = np.random.default_rng(9)
+    n, d, k = 9000, 500, 5
+    cols = np.stack([np.sort(rng.choice(d, k, replace=False))
+                     for _ in range(n)]).astype(np.int64)
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    rows = SparseRows.from_flat(np.arange(n + 1, dtype=np.int64) * k,
+                                cols.reshape(-1), vals.reshape(-1))
+    w = rng.normal(0, 1, d).astype(np.float32)
+
+    seen = []
+    orig = kernels.gather_rowsum
+
+    def spy(w_, vals_, cols_):
+        seen.append(vals_.shape)
+        return orig(w_, vals_, cols_)
+
+    monkeypatch.setattr(kernels, "gather_rowsum", spy)
+    out = _device_score_sparse(rows, w)
+    # One chunk at the 8192-rounded grid (16384), not 2,000,000.
+    assert seen == [(16384, k)]
+    np.testing.assert_allclose(out, rows.dot_dense(w.astype(np.float64)),
+                               rtol=2e-4, atol=2e-4)

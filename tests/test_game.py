@@ -347,9 +347,11 @@ def test_two_random_effects_config5_shape(rng):
 
 @pytest.mark.fast
 def test_validator_arity_shim():
-    """Legacy one-arg validators keep working; optional extras on a
-    legacy validator must not flip it to the new calling convention
-    (review finding)."""
+    """Exactly-one-positional callables are the legacy ``(total_scores)``
+    form; TWO OR MORE positional parameters — required or defaulted —
+    are the current ``(coefficients, total_scores)`` convention
+    (advisor finding: counting only required positionals misrouted a
+    ``(coefficients, total_scores=None)`` validator's arguments)."""
     from photon_ml_tpu.game.coordinate_descent import _call_validator
 
     calls = {}
@@ -357,15 +359,27 @@ def test_validator_arity_shim():
                     {"c": 1}, "T")
     assert calls["legacy"] == "T"
 
-    def legacy_with_extra(total_scores, sample_weight=None):
-        calls["extra"] = (total_scores, sample_weight)
-    _call_validator(legacy_with_extra, {"c": 1}, "T")
-    assert calls["extra"] == ("T", None)
-
     def new_style(coefs, total):
         calls["new"] = (coefs, total)
     _call_validator(new_style, {"c": 1}, "T")
     assert calls["new"] == ({"c": 1}, "T")
 
+    def new_optional_total(coefficients, total_scores=None):
+        calls["new_opt"] = (coefficients, total_scores)
+    _call_validator(new_optional_total, {"c": 1}, "T")
+    assert calls["new_opt"] == ({"c": 1}, "T")
+
+    def two_positional_defaults(coefficients=None, total_scores=None):
+        calls["two_def"] = (coefficients, total_scores)
+    _call_validator(two_positional_defaults, {"c": 1}, "T")
+    assert calls["two_def"] == ({"c": 1}, "T")
+
     _call_validator(lambda *a: calls.setdefault("varpos", a), {"c": 1}, "T")
     assert calls["varpos"] == ({"c": 1}, "T")
+
+    # Legacy with keyword-only extras stays legacy (the extras are not
+    # positional, so the positional count is still one).
+    def legacy_kwonly(total_scores, *, sample_weight=None):
+        calls["kwonly"] = total_scores
+    _call_validator(legacy_kwonly, {"c": 1}, "T")
+    assert calls["kwonly"] == "T"
