@@ -70,9 +70,14 @@ import numpy as np
 V5E_PEAK_GBPS = 819.0
 
 DEFAULT_SECTIONS = ("etl", "cached", "grr", "segment_sum", "colmajor")
-ALL_SECTIONS = DEFAULT_SECTIONS + ("powerlaw", "chunked")
+ALL_SECTIONS = DEFAULT_SECTIONS + ("powerlaw", "chunked", "sweep")
 DEFAULT_BUDGET_S = 840.0
 DEFAULT_N, DEFAULT_D, DEFAULT_K = 1_000_000, 100_000, 30
+
+# λ-sweep section shape: lanes × solver-iteration cap (kept static so
+# the batched and sequential arms solve the identical problem set).
+SWEEP_LANES = 6
+SWEEP_MAX_ITERS = 12
 
 # Per-section wall-clock estimates at the FULL bench shape on the
 # measured host (BENCH_r05 tail: etl 123 s, grr measure 346 s, colmajor
@@ -88,6 +93,9 @@ SECTION_EST_S = {
     "colmajor": 330.0,
     "powerlaw": 500.0,
     "chunked": 300.0,
+    # L+1 streamed solves over 4 ELL chunks (~(L·⌀16 + ~25) passes at
+    # ~1.5 s/pass at the full shape) + chunk ETL.
+    "sweep": 420.0,
 }
 
 
@@ -485,6 +493,148 @@ def section_chunked(ctx: BenchContext) -> None:
     }
 
 
+def section_sweep(ctx: BenchContext) -> None:
+    """Batched λ-sweep vs L× sequential fits (ISSUE 2 tentpole
+    measurement): the same L-point L2 grid over the chunked objective,
+    trained once as ONE swept masked-lane solve (one chunk stream feeds
+    all L coefficient lanes per evaluation) and once as L sequential
+    streaming fits.  Records wall time, data passes (full chunk
+    sweeps), and passes per grid step — the L → 1 amortization —
+    plus a batched-vs-sequential coefficient parity check."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.chunked_batch import build_chunked_batch
+    from photon_ml_tpu.data.normalization import NormalizationContext
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.ops.regularization import (
+        RegularizationContext,
+        RegularizationType,
+        SweptRegularization,
+    )
+    from photon_ml_tpu.optim import OptimizerConfig
+    from photon_ml_tpu.optim.streaming import (
+        ChunkedGLMObjective,
+        streaming_lbfgs_solve,
+        streaming_lbfgs_solve_swept,
+    )
+
+    cols, vals, labels = ctx.data()
+    n, d, k = ctx.n, ctx.d, ctx.k
+    L = SWEEP_LANES
+    lams = [float(10.0 ** e) for e in np.linspace(1.0, -2.0, L)]
+    cfg = OptimizerConfig(max_iters=SWEEP_MAX_ITERS, tolerance=1e-6)
+
+    t0 = time.time()
+    rows_sp = SparseRows.from_flat(
+        np.arange(n + 1, dtype=np.int64) * k,
+        cols.reshape(-1).astype(np.int64), vals.reshape(-1))
+    cb = build_chunked_batch(rows_sp, d, labels, n_chunks=4,
+                             layout="ell")
+    etl_s = time.time() - t0
+
+    def mk_obj(lam):
+        return GLMObjective(
+            loss=losses.LOGISTIC,
+            reg=RegularizationContext.l2(lam),
+            norm=NormalizationContext.identity(),
+        )
+
+    w0 = jnp.zeros((d,), jnp.float32)
+
+    # --- batched: one swept solve, all L lanes per data pass ---------
+    reg = SweptRegularization.from_grid(RegularizationType.L2, lams)
+    cobj_b = ChunkedGLMObjective(mk_obj(1.0), cb, max_resident=4)
+    W0 = jnp.zeros((L, d), jnp.float32)
+    # Warm both arms' compiles before timing (one max_iters=1 solve
+    # each) — the bench convention everywhere: compiles are one-time
+    # (and cached persistently), not per-grid cost.
+    t0 = time.time()
+    warm_cfg = OptimizerConfig(max_iters=1, tolerance=1e-6)
+    streaming_lbfgs_solve_swept(
+        lambda W: cobj_b.value_and_gradient_swept(W, reg),
+        lambda W: cobj_b.value_swept(W, reg),
+        W0, warm_cfg)
+    # The 1-iteration warm solve only exercises the value-only program
+    # if it happens to backtrack — compile it explicitly so a timed
+    # iteration's first backtrack can't pay the XLA compile.
+    cobj_b.value_swept(W0, reg)
+    co_w = ChunkedGLMObjective(mk_obj(1.0), cb, max_resident=4)
+    streaming_lbfgs_solve(co_w.value_and_gradient, w0, warm_cfg,
+                          value_fn=co_w.value)
+    co_w.value(w0)
+    compile_s = time.time() - t0
+    cobj_b.sweeps = 0
+    t0 = time.time()
+    res_b = streaming_lbfgs_solve_swept(
+        lambda W: cobj_b.value_and_gradient_swept(W, reg),
+        lambda W: cobj_b.value_swept(W, reg),
+        W0, cfg)
+    jax.block_until_ready(res_b.w)
+    batched_s = time.time() - t0
+    passes_b = cobj_b.sweeps
+    iters_b = int(jnp.max(res_b.iterations))          # grid steps
+    lane_iters_b = int(jnp.sum(res_b.iterations))
+    print(f"sweep batched: {batched_s:.1f}s, {passes_b} data passes, "
+          f"{iters_b} grid steps ({lane_iters_b} lane-iterations)",
+          file=sys.stderr)
+
+    # --- sequential: L independent streaming fits --------------------
+    seq_s = 0.0
+    passes_s = 0
+    iters_s = 0
+    W_seq = []
+    for lam in lams:
+        co = ChunkedGLMObjective(mk_obj(lam), cb, max_resident=4)
+        t0 = time.time()
+        r = streaming_lbfgs_solve(co.value_and_gradient, w0, cfg,
+                                  value_fn=co.value)
+        jax.block_until_ready(r.w)
+        seq_s += time.time() - t0
+        passes_s += co.sweeps
+        iters_s += int(r.iterations)
+        W_seq.append(np.asarray(r.w))
+    print(f"sweep sequential: {seq_s:.1f}s, {passes_s} data passes, "
+          f"{iters_s} lane-iterations", file=sys.stderr)
+
+    parity = float(np.max(np.abs(np.asarray(res_b.w) - np.stack(W_seq))))
+    # Passes per grid step (one iteration of EVERY lane): sequential
+    # pays ~L fits' worth; batched pays ~1-2 shared sweeps.
+    per_step_b = passes_b / max(iters_b, 1)
+    per_step_s = (passes_s / max(iters_s, 1)) * L
+    ctx.record["sweep"] = {
+        "lanes": L,
+        "max_iters": SWEEP_MAX_ITERS,
+        "batched_s": round(batched_s, 2),
+        "sequential_s": round(seq_s, 2),
+        "speedup": (round(seq_s / batched_s, 2) if batched_s > 0
+                    else None),
+        "etl_chunked_s": round(etl_s, 1),
+        "compile_s": round(compile_s, 1),
+        "parity_max_dw": parity,
+        "phases": {
+            "batched": {
+                "data_passes": passes_b,
+                "grid_steps": iters_b,
+                "lane_iterations": lane_iters_b,
+                "passes_per_grid_step": round(per_step_b, 2),
+            },
+            "sequential": {
+                "data_passes": passes_s,
+                "lane_iterations": iters_s,
+                "passes_per_grid_step": round(per_step_s, 2),
+            },
+        },
+        "pass_amortization": (round(per_step_s / per_step_b, 2)
+                              if per_step_b > 0 else None),
+    }
+    print(f"sweep: batched {batched_s:.1f}s vs sequential {seq_s:.1f}s "
+          f"-> {ctx.record['sweep']['speedup']}x; passes/grid-step "
+          f"{per_step_s:.1f} -> {per_step_b:.1f}", file=sys.stderr)
+
+
 SECTION_FNS = {
     "etl": section_etl,
     "cached": section_cached,
@@ -493,6 +643,7 @@ SECTION_FNS = {
     "segment_sum": section_segment_sum,
     "powerlaw": section_powerlaw,
     "chunked": section_chunked,
+    "sweep": section_sweep,
 }
 
 

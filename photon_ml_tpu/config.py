@@ -112,12 +112,22 @@ class TuningConfig:
         default_factory=dict
     )
     seed: int = 0
+    # Trials proposed (and, when the workload is swept-eligible,
+    # TRAINED) per batch: the batched λ-sweep evaluates a whole
+    # proposal round as one fit, amortizing the data stream across the
+    # round's lanes.  None = strategy default (RANDOM: 16 at a time —
+    # swept solver state is O(m·L·dim), so lanes stay bounded;
+    # BAYESIAN: small rounds so later proposals condition on earlier
+    # observations).
+    trial_batch: int | None = None
 
     def validate(self) -> None:
         if self.n_trials <= 0:
             raise ValueError("n_trials must be positive")
         if self.mode not in ("BAYESIAN", "RANDOM"):
             raise ValueError("tuning mode must be BAYESIAN or RANDOM")
+        if self.trial_batch is not None and self.trial_batch <= 0:
+            raise ValueError("trial_batch must be positive when set")
         if not self.reg_weight_ranges:
             raise ValueError("tuning needs reg_weight_ranges")
         for name, r in self.reg_weight_ranges.items():

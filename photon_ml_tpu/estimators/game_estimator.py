@@ -64,6 +64,7 @@ from photon_ml_tpu.ops.prior import GaussianPrior
 from photon_ml_tpu.ops.regularization import (
     RegularizationContext,
     RegularizationType,
+    SweptRegularization,
 )
 from photon_ml_tpu.optim import OptimizationProblem, OptimizerConfig
 from photon_ml_tpu.optim.variance import VarianceComputationType
@@ -578,6 +579,221 @@ class GameEstimator:
                 models[name].entity_key = coord_cfg.entity_key
         return GameModel(models=models)
 
+    # -- batched λ-sweep (one data stream for the whole grid) --------------
+
+    def _swept_coordinate_name(self) -> str | None:
+        """The single trainable fixed-effect coordinate eligible for
+        batched λ-sweep training, or None.
+
+        Eligibility: exactly one trainable (non-locked) coordinate in
+        the update sequence, FIXED_EFFECT, LBFGS/OWL-QN (TRON per-point
+        fits stay sequential), and no locked coordinate requesting
+        variances (those need per-coordinate score bookkeeping the
+        swept path doesn't carry).  Locked coordinates are fine
+        otherwise — their scores fold into the (lane-shared) offsets.
+        """
+        cfg = self.config
+        trainable = [n for n in dict.fromkeys(cfg.update_sequence)
+                     if n not in cfg.locked_coordinates]
+        if len(trainable) != 1:
+            return None
+        name = trainable[0]
+        by_name = {c.name: c for c in cfg.coordinates}
+        cc = by_name.get(name)
+        if cc is None or cc.kind != CoordinateKind.FIXED_EFFECT:
+            return None
+        from photon_ml_tpu.optim.base import OptimizerType
+
+        if cc.optimizer.optimizer == OptimizerType.TRON:
+            return None
+        for c in cfg.coordinates:
+            if (c.name in cfg.locked_coordinates
+                    and c.optimizer.variance_type
+                    != VarianceComputationType.NONE):
+                return None
+        return name
+
+    def _locked_offsets(self, coords, locked: dict, n: int):
+        """Offsets the trainable coordinate sees = Σ locked scores
+        (CD semantics with one trainable coordinate: total −
+        own-scores, and own scores cancel)."""
+        total = jnp.zeros((n,), jnp.float32)
+        for ln, lw in locked.items():
+            total = total + coords[ln].score(lw)
+        return total
+
+    def _lane_coordinate(self, coord, coord_cfg: CoordinateConfig,
+                         lam: float):
+        """Clone of a fixed-effect coordinate with one lane's λ
+        installed — for per-lane variance computation (the Hessian
+        includes λ₂)."""
+        from photon_ml_tpu.game.coordinates import (
+            ChunkedFixedEffectCoordinate,
+        )
+
+        reg1 = SweptRegularization.from_grid(
+            coord_cfg.optimizer.regularization, [lam],
+            coord_cfg.optimizer.elastic_net_alpha)
+        if isinstance(coord, ChunkedFixedEffectCoordinate):
+            base = coord.objective
+            obj_l = base.replace(reg=base.reg.replace(
+                l1_weight=reg1.l1_weights[0],
+                l2_weight=reg1.l2_weights[0]))
+            return ChunkedFixedEffectCoordinate(
+                name=coord.name, chunked=coord.chunked, objective=obj_l,
+                optimizer=coord.optimizer, config=coord.config,
+                max_resident=coord.max_resident)
+        base = coord.problem.objective
+        obj_l = base.replace(reg=base.reg.replace(
+            l1_weight=reg1.l1_weights[0], l2_weight=reg1.l2_weights[0]))
+        dist_l = (None if coord.distributed is None
+                  else coord.distributed.replace(objective=obj_l))
+        return dataclasses.replace(
+            coord, problem=coord.problem.replace(objective=obj_l),
+            distributed=dist_l)
+
+    def _swept_lane_model(self, coords, name: str, w_j, locked: dict,
+                          offsets, lam: float,
+                          with_variances: bool = True) -> GameModel:
+        """One lane's GameModel: the snapshot export (fixed effect at
+        this λ plus the locked coordinates), with the trainable entry
+        re-exported variance-bearing when requested (variances need
+        the LANE's reg context — the Hessian includes λ₂)."""
+        model = self._model_snapshot(coords, {**locked, name: w_j})
+        by_name = {c.name: c for c in self.config.coordinates}
+        cc = by_name[name]
+        vtype = cc.optimizer.variance_type
+        if with_variances and vtype != VarianceComputationType.NONE:
+            variances = self._lane_coordinate(
+                coords[name], cc, lam).compute_variances(
+                    w_j, offsets, vtype)
+            model.models[name] = self._export_fixed(
+                coords[name], w_j, cc, variances)
+        return model
+
+    def _train_swept_lanes(self, coords, name: str, lams, offsets,
+                          locked: dict, validation, run_logger,
+                          warm_W=None, base_w0=None):
+        """Train λ lanes as ONE batched sweep; returns (FitResults in
+        the order of ``lams``, W [L, dim] in that order).
+
+        Lanes run λ-DESCENDING inside the solve (continuation order:
+        strongly regularized lanes converge first and coast under the
+        masked while_loop while weakly regularized stragglers keep
+        refining); results are mapped back to the caller's order.
+        """
+        import time as _time
+
+        cfg = self.config
+        by_name = {c.name: c for c in cfg.coordinates}
+        cc = by_name[name]
+        coord = coords[name]
+        lams_arr = np.asarray(lams, np.float32)
+        order = np.argsort(-lams_arr, kind="stable")
+        inv = np.empty_like(order)
+        inv[order] = np.arange(len(order))
+        reg = SweptRegularization.from_grid(
+            cc.optimizer.regularization, lams_arr[order],
+            cc.optimizer.elastic_net_alpha)
+        L = len(lams)
+        if warm_W is not None:
+            W = jnp.asarray(warm_W)[jnp.asarray(order)]
+        elif base_w0 is not None:
+            W = jnp.tile(jnp.asarray(base_w0)[None, :], (L, 1))
+        else:
+            W = None
+        t0 = _time.perf_counter()
+        res = None
+        inv_idx = jnp.asarray(inv)
+        # Per-sweep validation mirrors _fit_point's validator (the
+        # reference scores validation data every CD iteration): one
+        # snapshot evaluation per lane per sweep — the same L·n_iter
+        # transforms the sequential grid pays.
+        validate = (validation is not None and cfg.validate_per_iteration)
+        lane_history: list[list] = [[] for _ in range(L)]
+        for _ in range(cfg.n_iterations):
+            W, res = coord.train_swept(offsets, reg, warm_start=W)
+            if validate:
+                W_now = W[inv_idx]
+                for j in range(L):
+                    snap = self._swept_lane_model(
+                        coords, name, W_now[j], locked, offsets,
+                        float(lams[j]), with_variances=False)
+                    lane_history[j].append(
+                        self._evaluate(snap, validation))
+        elapsed = _time.perf_counter() - t0
+        logger.info("swept fit: %d λ-lanes of '%s' in %.2fs", L, name,
+                    elapsed)
+        if run_logger is not None:
+            run_logger.event(
+                "swept_fit", coordinate=name, lanes=L,
+                duration_s=round(elapsed, 4),
+                lanes_converged=int(jnp.sum(res.converged)),
+                max_solver_iterations=int(jnp.max(res.iterations)),
+            )
+        W_out = W[inv_idx]
+        results = []
+        for j in range(L):
+            # The caller's λ, not the float32 round-trip (reg_weights
+            # in the FitResult must equal the grid/proposal values).
+            lam = float(lams[j])
+            model = self._swept_lane_model(coords, name, W_out[j],
+                                           locked, offsets, lam)
+            if lane_history[j]:
+                # The last sweep's snapshot IS the final model
+                # (variances don't affect scoring) — _fit_point rule.
+                evals = dict(lane_history[j][-1])
+            else:
+                evals = (self._evaluate(model, validation)
+                         if validation is not None else {})
+            results.append(FitResult(
+                model=model, evaluations=evals,
+                reg_weights={c.name: (lam if c.name == name
+                                      else c.optimizer.reg_weight)
+                             for c in cfg.coordinates},
+                validation_history=lane_history[j],
+            ))
+        return results, W_out
+
+    def _swept_setup(self, train: GameDataset, prep: dict, name: str,
+                     lam_build: float):
+        """Shared swept-fit preamble: coordinates built once (at the
+        largest λ, so the reg context carries the intercept mask), warm
+        coefficients, locked-coordinate filter, lane-shared offsets.
+
+        Returns (coords, locked, offsets, base_w0)."""
+        cfg = self.config
+        coords = self._build_coordinates(train, prep, {name: lam_build})
+        warm = self._warm_coefficients(coords, prep)
+        locked = {n: warm[n] for n in cfg.locked_coordinates if n in warm}
+        missing = set(cfg.locked_coordinates) - set(locked)
+        if missing:
+            raise ValueError(
+                f"locked coordinates {sorted(missing)} absent from "
+                "the warm-start model")
+        offsets = self._locked_offsets(coords, locked, train.n)
+        return coords, locked, offsets, warm.get(name)
+
+    def _fit_grid_swept(self, train: GameDataset, prep: dict, name: str,
+                        grid_points: list[dict], validation,
+                        run_logger) -> list[FitResult]:
+        """The whole ``reg_weight_grid`` as ONE batched sweep: L
+        coefficient lanes share every objective evaluation (data
+        stream) instead of paying one full fit per grid point.
+        Returns results in grid order (the ``fit`` contract), with
+        per-sweep ``validation_history`` per lane when
+        ``validate_per_iteration`` is on — the same record the
+        per-point path produces."""
+        lams = [gp[name] for gp in grid_points]
+        coords, locked, offsets, base_w0 = self._swept_setup(
+            train, prep, name, max(lams))
+        logger.info("fit: swept λ grid over '%s' (%d lanes)", name,
+                    len(lams))
+        results, _ = self._train_swept_lanes(
+            coords, name, lams, offsets, locked, validation, run_logger,
+            base_w0=base_w0)
+        return results
+
     # -- fit ---------------------------------------------------------------
 
     def _grid_points(self) -> list[dict]:
@@ -664,7 +880,12 @@ class GameEstimator:
     def fit(self, train: GameDataset,
             validation: GameDataset | None = None,
             run_logger=None) -> list[FitResult]:
-        """Train once per grid point; returns results in grid order."""
+        """Train the λ grid; returns results in grid order.
+
+        An eligible fixed-effect grid (see ``_swept_coordinate_name``)
+        trains as ONE batched sweep — every grid point shares each
+        objective evaluation's data stream instead of paying its own
+        full fit; other shapes fit once per grid point."""
         # Programmatic callers (no driver) still get the warm compile
         # path from config; no-op when neither config nor env sets it.
         from photon_ml_tpu.cache import enable_compilation_cache
@@ -672,6 +893,12 @@ class GameEstimator:
         enable_compilation_cache(self.config.compilation_cache_dir)
         prep = self._prepare(train)
         grid_points = self._grid_points()
+        name = self._swept_coordinate_name()
+        if (len(grid_points) > 1 and name is not None
+                and set(self.config.reg_weight_grid) == {name}
+                and not self.config.checkpoint_dir):
+            return self._fit_grid_swept(train, prep, name, grid_points,
+                                        validation, run_logger)
         return [
             self._fit_point(
                 train, prep, reg_weights, validation, run_logger,
@@ -707,6 +934,18 @@ class GameEstimator:
             for name, r in sorted(tuning.reg_weight_ranges.items())
         ])
         prep = self._prepare(train)
+        tuner = HyperparameterTuner(
+            space,
+            mode=TunerMode(tuning.mode),
+            larger_is_better=ev.larger_is_better,
+            seed=tuning.seed,
+        )
+
+        swept_name = self._swept_coordinate_name()
+        if (swept_name is not None
+                and set(tuning.reg_weight_ranges) == {swept_name}):
+            return self._fit_tuned_swept(train, prep, swept_name, tuner,
+                                         validation, run_logger, ev)
 
         def evaluate_fn(point: dict):
             result = self._fit_point(
@@ -714,14 +953,46 @@ class GameEstimator:
                 ckpt_tag=None)
             return result.evaluations[ev], result
 
-        tuner = HyperparameterTuner(
-            space,
-            mode=TunerMode(tuning.mode),
-            larger_is_better=ev.larger_is_better,
-            seed=tuning.seed,
-        )
         trials = tuner.run(evaluate_fn, tuning.n_trials,
                            run_logger=run_logger)
+        return [t.payload for t in trials]
+
+    def _fit_tuned_swept(self, train: GameDataset, prep: dict, name: str,
+                         tuner, validation: GameDataset, run_logger,
+                         ev) -> list[FitResult]:
+        """Batched trial evaluation: each tuner round proposes a BATCH
+        of λ points (``propose_batch`` — one GP fit per round) and the
+        whole batch trains as one swept solve, so a round of q trials
+        pays ~one fit's worth of data streams instead of q.
+
+        Warm-start continuation across rounds: each new lane starts
+        from the previous round's nearest-log-λ solution (lanes
+        ordered λ-descending inside each solve)."""
+        tuning = self.config.tuning
+        hi = float(tuning.reg_weight_ranges[name]["high"])
+        coords, locked, offsets, base_w0 = self._swept_setup(
+            train, prep, name, hi)
+        prev: dict = {"lams": None, "W": None}
+
+        def evaluate_batch(configs: list[dict]):
+            lams = [float(c[name]) for c in configs]
+            warm_W = None
+            if prev["W"] is not None:
+                log_prev = np.log(np.maximum(
+                    np.asarray(prev["lams"], np.float64), 1e-30))
+                idx = [int(np.argmin(np.abs(
+                    np.log(max(lam, 1e-30)) - log_prev)))
+                    for lam in lams]
+                warm_W = jnp.stack([prev["W"][i] for i in idx])
+            results, W_out = self._train_swept_lanes(
+                coords, name, lams, offsets, locked, validation,
+                run_logger, warm_W=warm_W, base_w0=base_w0)
+            prev["lams"], prev["W"] = lams, W_out
+            return [(r.evaluations[ev], r) for r in results]
+
+        trials = tuner.run_batched(
+            evaluate_batch, tuning.n_trials,
+            batch_size=tuning.trial_batch, run_logger=run_logger)
         return [t.payload for t in trials]
 
     def best(self, results: list[FitResult]) -> FitResult:

@@ -225,7 +225,10 @@ def run_coordinate_descent(
             w, diag = coord.train(offsets, coefs.get(name),
                                   donate_warm_start=True)
             new_scores = coord.score(w)
-            total = total - scores[name] + new_scores
+            # ``offsets`` already holds total − old scores; reusing it
+            # saves one [n]-vector op per coordinate per sweep (and
+            # matches the reference's residual algebra exactly).
+            total = offsets + new_scores
             scores[name] = new_scores
             coefs[name] = w
             iter_diag[name] = diag

@@ -151,6 +151,51 @@ _fixed_train_distributed, _fixed_train_distributed_donating = _jit_solve(
     _fixed_train_distributed_impl, donate_argnums=(8,))  # w0
 
 
+def _lane_vg(objective, view):
+    """Per-lane smooth objective for the swept solvers: the lane's L2
+    weight rides as the lane context (a traced [L] leaf row), so one
+    compiled program covers any λ grid."""
+    def vg(w, l2):
+        obj = objective.replace(reg=objective.reg.replace(l2_weight=l2))
+        return obj.value_and_gradient(w, view)
+    return vg
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _fixed_train_swept(config, use_map, objective, batch, offsets,
+                       train_idx, train_weights, W0, l2s, l1v):
+    """Batched λ-sweep fixed-effect solve: W0 [L, d] lanes against ONE
+    shared training view — the whole regularization grid in a single
+    masked-lane program (``optim.lbfgs.lbfgs_solve_swept``).
+    ``use_map`` (static) lane-loops via ``lax.map`` when the batch
+    carries a GRR plan (the Pallas kernel has no batching rule)."""
+    from photon_ml_tpu.optim.lbfgs import lbfgs_solve_swept
+
+    view = _apply_training_view(batch, offsets, train_idx, train_weights)
+    return lbfgs_solve_swept(_lane_vg(objective, view), W0, l2s, config,
+                             l1_weights=l1v, use_map=use_map)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _fixed_train_swept_distributed(config, dist_obj, batch, offsets,
+                                   train_idx, train_weights, W0, l2s, l1v):
+    """Mesh variant of the swept solve: lanes lax.map-loop around the
+    shard_mapped objective (no batching rule through shard_map); the
+    sharded batch stays resident across every lane."""
+    from photon_ml_tpu.optim.lbfgs import lbfgs_solve_swept
+
+    view = _apply_training_view(batch, offsets, train_idx, train_weights)
+
+    def vg(w, l2):
+        obj = dist_obj.objective
+        o = dist_obj.replace(objective=obj.replace(
+            reg=obj.reg.replace(l2_weight=l2)))
+        return o.value_and_gradient(w, view)
+
+    return lbfgs_solve_swept(vg, W0, l2s, config, l1_weights=l1v,
+                             use_map=True)
+
+
 @jax.jit
 def _score_batch(batch, w: Array) -> Array:
     return batch.x_dot(w)
@@ -283,6 +328,54 @@ class FixedEffectCoordinate(Coordinate):
             )
         return res.w, res
 
+    def train_swept(self, offsets: Array, reg, warm_start=None):
+        """Train the whole λ grid as ONE batched solve: L stacked
+        coefficient lanes share every objective evaluation against the
+        same training view (one data stream amortized across the grid).
+
+        Args:
+          offsets: [n] shared residual scores (the λ sweep varies only
+            regularization, so all lanes see the same offsets).
+          reg: ``ops.regularization.SweptRegularization`` — per-lane
+            (l1, l2) weight splits, one lane per grid point.
+          warm_start: optional [L, dim] stacked starting points
+            (continuation across tuning rounds).
+
+        Returns (W [L, dim], batched OptimizationResult).
+        """
+        from photon_ml_tpu.data.batch import SparseBatch
+        from photon_ml_tpu.optim.base import OptimizerType
+
+        if self.problem.optimizer == OptimizerType.TRON:
+            raise ValueError(
+                "train_swept supports LBFGS/OWL-QN lanes only (the λ "
+                "sweep is the L-BFGS grid workload; fit TRON "
+                "coordinates per grid point)")
+        L = reg.n_lanes
+        dim = self.batch.dim
+        W0 = (jnp.zeros((L, dim), jnp.float32) if warm_start is None
+              else jnp.asarray(warm_start, jnp.float32))
+        l1v = (reg.l1_vectors(dim, self.problem.objective.reg.reg_mask)
+               if reg.has_l1() else None)
+        if self.distributed is not None:
+            res = _fixed_train_swept_distributed(
+                self.problem.config, self.distributed, self.batch,
+                offsets, self.train_idx, self.train_weights, W0,
+                reg.l2_weights, l1v,
+            )
+        else:
+            # GRR-plan batches lane-loop (lax.map): the Mosaic kernel
+            # has no batching rule; the plan stays HBM-resident across
+            # lanes either way.
+            use_map = (isinstance(self.batch, SparseBatch)
+                       and self.batch.grr is not None)
+            res = _fixed_train_swept(
+                self.problem.config, use_map, self.problem.objective,
+                self.batch, offsets, self.train_idx, self.train_weights,
+                W0, reg.l2_weights, l1v,
+            )
+        return res.w, res
+
     def score(self, coefficients: Array) -> Array:
         if self.distributed is not None:
             scores = _score_batch_distributed(
@@ -389,7 +482,36 @@ class ChunkedFixedEffectCoordinate(Coordinate):
         l1 = (problem._l1_vector(self.chunked.dim) if problem.has_l1()
               else None)
         res = streaming_lbfgs_solve(
-            self._obj.value_and_gradient, w0, self.config, l1_weight=l1)
+            self._obj.value_and_gradient, w0, self.config, l1_weight=l1,
+            value_fn=self._obj.value)
+        return res.w, res
+
+    def train_swept(self, offsets: Array, reg, warm_start=None):
+        """Batched λ-sweep on the chunked path: ONE double-buffered
+        chunk sweep per objective evaluation feeds all L lanes
+        (``ChunkedGLMObjective.value_and_gradient_swept``) — the grid's
+        data passes per solver iteration drop from L to ~1.
+
+        Same contract as ``FixedEffectCoordinate.train_swept``.
+        """
+        from photon_ml_tpu.optim.streaming import (
+            streaming_lbfgs_solve_swept,
+        )
+
+        self.chunked.set_offsets(self._coerce_offsets(offsets))
+        self._obj.invalidate()
+        L = reg.n_lanes
+        W0 = (jnp.zeros((L, self.chunked.dim), jnp.float32)
+              if warm_start is None
+              else jnp.asarray(warm_start, jnp.float32))
+        l1v = (reg.l1_vectors(self.chunked.dim,
+                              self.objective.reg.reg_mask)
+               if reg.has_l1() else None)
+        res = streaming_lbfgs_solve_swept(
+            lambda W: self._obj.value_and_gradient_swept(W, reg),
+            lambda W: self._obj.value_swept(W, reg),
+            W0, self.config, l1_weights=l1v,
+        )
         return res.w, res
 
     def score(self, coefficients: Array) -> Array:

@@ -99,12 +99,25 @@ def expected_improvement(mean: Array, std: Array, best: Array) -> Array:
 class RandomSearch:
     """Quasi-uniform proposals (reference ``RandomSearch``)."""
 
+    # Random proposals are independent, so a batched evaluator (the
+    # swept-λ GameEstimator) could take the whole trial budget at once
+    # — but swept L-BFGS state scales O(m·L·dim) ([m, L, d] curvature
+    # buffers), so an unbounded lane count would OOM wide problems
+    # (d=10⁶ × L=100 ≈ 8 GB of (s, y) buffers alone).  Default to a
+    # bounded batch; callers with headroom raise it via
+    # TuningConfig.trial_batch.
+    default_batch: int | None = 16
+
     def __init__(self, space: SearchSpace, seed: int = 0):
         self.space = space
         self._rng = np.random.default_rng(seed)
 
     def propose(self, history: list) -> dict:
         return self.space.from_unit(self._rng.uniform(size=self.space.dim))
+
+    def propose_batch(self, history: list, q: int) -> list[dict]:
+        """q independent proposals (batched trial evaluation)."""
+        return [self.propose(history) for _ in range(q)]
 
 
 class GaussianProcessSearch:
@@ -133,9 +146,13 @@ class GaussianProcessSearch:
         self._rng = np.random.default_rng(seed)
         self._random = RandomSearch(space, seed=seed + 1)
 
-    def propose(self, history: list) -> dict:
-        if len(history) < self.min_observations:
-            return self._random.propose(history)
+    # GP proposals condition on history, so batches stay small (a few
+    # points per GP fit) — see ``propose_batch``.
+    default_batch: int | None = 4
+
+    def _ei_candidates(self, history: list):
+        """One GP fit → (candidates [C, dim], EI [C]) shared by single
+        and batched proposal."""
         x = np.stack([self.space.to_unit(cfg) for cfg, _ in history])
         y = np.asarray([m for _, m in history], np.float32)
         if not self.larger_is_better:
@@ -154,4 +171,42 @@ class GaussianProcessSearch:
         cands = np.vstack([cands, local])
         mean, std = gp.predict(jnp.asarray(cands))
         ei = expected_improvement(mean, std, jnp.max(jnp.asarray(y)))
-        return self.space.from_unit(cands[int(jnp.argmax(ei))])
+        return cands, np.asarray(ei)
+
+    def propose(self, history: list) -> dict:
+        if len(history) < self.min_observations:
+            return self._random.propose(history)
+        cands, ei = self._ei_candidates(history)
+        return self.space.from_unit(cands[int(np.argmax(ei))])
+
+    def propose_batch(self, history: list, q: int,
+                      min_dist: float = 0.05) -> list[dict]:
+        """q proposals from ONE GP fit (batched trial evaluation).
+
+        EI-ranked candidates with a greedy min-distance filter so the
+        batch SPREADS over the acquisition surface instead of piling q
+        near-duplicates onto the EI argmax (a cheap stand-in for
+        constant-liar q-EI: no GP refit between picks, which is the
+        point — one fit per round).  Before ``min_observations`` the
+        batch is random, seeding the GP."""
+        if len(history) < self.min_observations:
+            return [self._random.propose(history) for _ in range(q)]
+        cands, ei = self._ei_candidates(history)
+        order = np.argsort(-ei)
+        picked: list[np.ndarray] = []
+        for i in order:
+            if len(picked) == q:
+                break
+            c = cands[i]
+            if any(np.linalg.norm(c - p) < min_dist for p in picked):
+                continue
+            picked.append(c)
+        # Degenerate surfaces (every candidate inside min_dist of the
+        # picks): fill with next-best regardless of spacing.
+        for i in order:
+            if len(picked) == q:
+                break
+            c = cands[i]
+            if not any(np.array_equal(c, p) for p in picked):
+                picked.append(c)
+        return [self.space.from_unit(c) for c in picked]

@@ -69,6 +69,36 @@ class HyperparameterTuner:
                                  metric=float(metric))
         return trials
 
+    def run_batched(self, evaluate_batch_fn, n_trials: int,
+                    batch_size: int | None = None,
+                    run_logger=None) -> list[TrialResult]:
+        """Drive trials in proposal BATCHES: each round proposes q
+        configs (one GP fit / spread-EI pick for Bayesian, plain draws
+        for random — ``propose_batch``) and hands the whole list to
+        ``evaluate_batch_fn(configs) → [(metric, payload), ...]``, so a
+        batched evaluator (the swept-λ ``GameEstimator``) trains the
+        round as one fit.  ``batch_size`` None uses the strategy's
+        ``default_batch`` (random: 16 — bounded, since swept solver
+        state scales with lane count; GP: small rounds so later
+        proposals condition on earlier observations)."""
+        history: list = []
+        trials: list[TrialResult] = []
+        while len(trials) < n_trials:
+            q = batch_size or getattr(self.search, "default_batch",
+                                      None) or (n_trials - len(trials))
+            q = min(q, n_trials - len(trials))
+            configs = self.search.propose_batch(history, q)
+            outs = evaluate_batch_fn(configs)
+            for config, (metric, payload) in zip(configs, outs):
+                history.append((config, metric))
+                trials.append(TrialResult(
+                    config=config, metric=float(metric), payload=payload))
+                if run_logger is not None:
+                    run_logger.event(
+                        "tuning_trial", trial=len(trials) - 1,
+                        config=config, metric=float(metric))
+        return trials
+
     def best(self, trials: list[TrialResult]) -> TrialResult:
         key = (max if self.larger_is_better else min)
         return key(trials, key=lambda t: t.metric)

@@ -183,3 +183,67 @@ def as_fns(obj: GLMObjective, batch: Batch) -> ObjectiveFns:
         value_and_grad=lambda w: obj.value_and_gradient(w, batch),
         hvp=lambda w, v: obj.hessian_vector(w, v, batch),
     )
+
+
+# ---------------------------------------------------------------------------
+# Swept (stacked-coefficient) surface: evaluate L λ-lanes against ONE
+# shared batch.  The λ grid's dominant cost is moving the batch through
+# the memory system (GRR plans stream at ~30% of HBM roofline; the
+# chunked regime pays 6.5 s per full-data pass — PERF.md), so the sweep
+# evaluates W [L, dim] with ``vmap(in_axes=(0, None))``: the batch is
+# read once and every lane contracts against it.  Per-lane L2 weight
+# rides as a [L] array (λ is a traced leaf, so one compiled program
+# covers any grid).  GRR-plan batches get a ``lax.map`` lane loop
+# instead — the Mosaic kernel has no batching rule, and the data is
+# already resident so the loop still reads it from HBM, not the host.
+# ---------------------------------------------------------------------------
+
+
+def _lane_objective(obj: GLMObjective, l2_weight: Array) -> GLMObjective:
+    """``obj`` with one lane's (traced scalar) L2 weight installed.
+
+    Only the smooth L2 part varies inside a swept evaluation; per-lane
+    L1 is the optimizer's business (OWL-QN), exactly as in the
+    single-lane convention (module docstring).
+    """
+    return obj.replace(reg=obj.reg.replace(l2_weight=l2_weight))
+
+
+def sweep_value_and_gradient(
+    obj: GLMObjective, W: Array, batch: Batch,
+    l2_weights: Array | None = None, use_map: bool = False,
+) -> tuple[Array, Array]:
+    """(W [L, dim], shared batch) → (values [L], gradients [L, dim]).
+
+    ``l2_weights`` [L] installs a per-lane L2 weight (None keeps the
+    objective's own, shared across lanes — the chunked inner sweep,
+    whose reg is added outside the chunk loop).  ``use_map`` switches
+    the lane axis from ``vmap`` to a ``lax.map`` loop (GRR plans /
+    shard_mapped objectives, which have no batching rule).
+    """
+    if l2_weights is None:
+        fn = lambda w: obj.value_and_gradient(w, batch)
+        xs = W
+    else:
+        fn = lambda args: _lane_objective(obj, args[1]).value_and_gradient(
+            args[0], batch)
+        xs = (W, l2_weights)
+    if use_map:
+        return jax.lax.map(fn, xs)
+    return jax.vmap(fn)(xs)
+
+
+def sweep_value(
+    obj: GLMObjective, W: Array, batch: Batch,
+    l2_weights: Array | None = None, use_map: bool = False,
+) -> Array:
+    """Value-only lane sweep (line-search trials): W [L, dim] → [L]."""
+    if l2_weights is None:
+        fn = lambda w: obj.value(w, batch)
+        xs = W
+    else:
+        fn = lambda args: _lane_objective(obj, args[1]).value(args[0], batch)
+        xs = (W, l2_weights)
+    if use_map:
+        return jax.lax.map(fn, xs)
+    return jax.vmap(fn)(xs)

@@ -25,6 +25,7 @@ import enum
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 Array = jax.Array
@@ -114,3 +115,59 @@ def exclude_intercept_mask(dim: int, intercept_index: int | None) -> Array | Non
     if intercept_index is None:
         return None
     return jnp.ones((dim,), jnp.float32).at[intercept_index].set(0.0)
+
+
+@struct.dataclass
+class SweptRegularization:
+    """Per-lane regularization weights for a batched λ sweep.
+
+    One lane per λ-grid point: ``l1_weights[l]`` / ``l2_weights[l]`` are
+    the lane's split under the same reference convention as
+    ``RegularizationContext`` (L2 → (0, λ); L1 → (λ, 0); elastic net →
+    (α·λ, (1−α)·λ)).  The shared ``reg_mask`` (intercept exemption)
+    stays on the base context — lanes differ only in weight.
+    """
+
+    l1_weights: Array  # [L]
+    l2_weights: Array  # [L]
+
+    @staticmethod
+    def from_grid(
+        regularization: "RegularizationType | str",
+        weights,
+        elastic_net_alpha: float = 0.5,
+    ) -> "SweptRegularization":
+        """λ grid [L] → per-lane (l1, l2) splits."""
+        lam = jnp.asarray(np.asarray(weights, np.float32))
+        reg = RegularizationType(regularization)
+        if reg == RegularizationType.L2:
+            l1, l2 = jnp.zeros_like(lam), lam
+        elif reg == RegularizationType.L1:
+            l1, l2 = lam, jnp.zeros_like(lam)
+        elif reg == RegularizationType.ELASTIC_NET:
+            l1 = elastic_net_alpha * lam
+            l2 = (1.0 - elastic_net_alpha) * lam
+        else:  # NONE
+            l1, l2 = jnp.zeros_like(lam), jnp.zeros_like(lam)
+        return SweptRegularization(l1_weights=l1, l2_weights=l2)
+
+    @property
+    def n_lanes(self) -> int:
+        return self.l1_weights.shape[0]
+
+    def has_l1(self) -> bool:
+        """Concrete any-lane L1 presence (OWL-QN routing for the whole
+        sweep; must be decided outside jit, like ``OptimizationProblem
+        .has_l1``).  A zero-λ lane inside an L1 sweep rides the OWL-QN
+        loop with an all-zero l1 vector."""
+        return bool(np.any(np.asarray(self.l1_weights) != 0.0))
+
+    def l1_vectors(self, dim: int, reg_mask: Array | None) -> Array:
+        """Per-lane [L, dim] OWL-QN weight vectors (mask applied)."""
+        vecs = jnp.broadcast_to(
+            self.l1_weights[:, None].astype(jnp.float32),
+            (self.n_lanes, dim),
+        )
+        if reg_mask is not None:
+            vecs = vecs * reg_mask
+        return vecs

@@ -10,11 +10,17 @@ from photon_ml_tpu.optim.base import (
     OptimizerType,
     StatesTracker,
 )
-from photon_ml_tpu.optim.lbfgs import lbfgs_solve, owlqn_solve
+from photon_ml_tpu.optim.lbfgs import (
+    lbfgs_solve,
+    lbfgs_solve_swept,
+    owlqn_solve,
+    owlqn_solve_swept,
+)
 from photon_ml_tpu.optim.problem import OptimizationProblem, solve_batched
 from photon_ml_tpu.optim.streaming import (
     ChunkedGLMObjective,
     streaming_lbfgs_solve,
+    streaming_lbfgs_solve_swept,
 )
 from photon_ml_tpu.optim.tron import tron_solve
 
@@ -24,10 +30,13 @@ __all__ = [
     "OptimizerType",
     "StatesTracker",
     "lbfgs_solve",
+    "lbfgs_solve_swept",
     "owlqn_solve",
+    "owlqn_solve_swept",
     "tron_solve",
     "OptimizationProblem",
     "solve_batched",
     "ChunkedGLMObjective",
     "streaming_lbfgs_solve",
+    "streaming_lbfgs_solve_swept",
 ]

@@ -331,3 +331,66 @@ def owlqn_solve(
 ) -> OptimizationResult:
     """OWL-QN = L-BFGS with orthant-wise L1 handling (reference ``OWLQN``)."""
     return lbfgs_solve(value_and_grad, w0, config, l1_weight=l1_weight)
+
+
+def lbfgs_solve_swept(
+    value_and_grad,
+    w0s: Array,
+    lane_ctx,
+    config: OptimizerConfig = OptimizerConfig(),
+    l1_weights: Array | None = None,
+    use_map: bool = False,
+) -> OptimizationResult:
+    """Batched masked-lane L-BFGS / OWL-QN over L concurrent problems.
+
+    The λ-sweep entry: one solve drives every grid point at once, so
+    each objective evaluation inside the ``while_loop`` serves all L
+    coefficient lanes against the SAME closed-over batch — one data
+    stream amortized across the grid.  This is the proven
+    masked-``while_loop`` vmap pattern of the random-effects bucket
+    path (``game.coordinates._re_train_impl``): converged lanes coast
+    under their ``done`` guard while stragglers finish.
+
+    Args:
+      value_and_grad: per-lane smooth objective
+        ``(w [dim], lane_ctx_l) → (f, g)``; per-lane parameters (the
+        lane's L2 weight, typically) ride in ``lane_ctx``.
+      w0s: [L, dim] stacked starting points.
+      lane_ctx: pytree whose leaves have leading axis L; row l is
+        passed to ``value_and_grad`` for lane l.
+      l1_weights: None (plain L-BFGS) or per-lane L1 weights — [L]
+        scalars or [L, dim] vectors — activating OWL-QN semantics on
+        EVERY lane (a zero row degrades to an all-zero l1 vector).
+      use_map: run the lane axis as a ``lax.map`` loop instead of
+        ``vmap`` — for objectives with no batching rule (GRR Pallas
+        kernel, shard_mapped distributed objectives).  Still one
+        compiled program over the whole grid; the amortization is then
+        HBM-residency rather than a shared read.
+    """
+    if l1_weights is not None:
+        def lane(args):
+            w0, ctx, l1 = args
+            return lbfgs_solve(lambda w: value_and_grad(w, ctx), w0,
+                               config, l1_weight=l1)
+        xs = (w0s, lane_ctx, l1_weights)
+    else:
+        def lane(args):
+            w0, ctx = args
+            return lbfgs_solve(lambda w: value_and_grad(w, ctx), w0, config)
+        xs = (w0s, lane_ctx)
+    if use_map:
+        return jax.lax.map(lane, xs)
+    return jax.vmap(lane)(xs)
+
+
+def owlqn_solve_swept(
+    value_and_grad,
+    w0s: Array,
+    lane_ctx,
+    l1_weights: Array,
+    config: OptimizerConfig = OptimizerConfig(),
+    use_map: bool = False,
+) -> OptimizationResult:
+    """Batched-lane OWL-QN (see ``lbfgs_solve_swept``)."""
+    return lbfgs_solve_swept(value_and_grad, w0s, lane_ctx, config,
+                             l1_weights=l1_weights, use_map=use_map)

@@ -22,19 +22,34 @@ tests, but with a Python outer loop calling a host-level
 ``value_and_grad``.  Per-iteration [dim]-vector math dispatches eagerly
 (a handful of cached device ops — microseconds of compute); the data
 passes dominate, exactly as in the reference's driver loop.
+
+λ-sweep amortization: the data passes are also λ-INDEPENDENT (reg is
+added outside the chunk loop), so ``value_and_gradient_swept`` feeds L
+stacked coefficient lanes from ONE double-buffered chunk sweep and
+``streaming_lbfgs_solve_swept`` runs the whole regularization grid as
+one masked-lane solve — data passes per solver iteration drop from L
+to ~1 (see ``ops.objective`` swept surface).
 """
 
 from __future__ import annotations
 
 import logging
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data.chunked_batch import ChunkedBatch
-from photon_ml_tpu.ops.objective import GLMObjective
-from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.ops.objective import (
+    GLMObjective,
+    sweep_value,
+    sweep_value_and_gradient,
+)
+from photon_ml_tpu.ops.regularization import (
+    RegularizationContext,
+    SweptRegularization,
+)
 from photon_ml_tpu.optim.base import (
     OptimizationResult,
     OptimizerConfig,
@@ -73,6 +88,100 @@ def _place_chunk(chunk, mesh):
     return jax.tree.map(asm, *chunk)
 
 
+# ---------------------------------------------------------------------------
+# Per-chunk device programs, jitted at MODULE level so every
+# ChunkedGLMObjective instance shares one compile cache: λ-grid /
+# tuning points build a fresh objective per point, and per-instance jit
+# wrappers would recompile the identical program once per point (the
+# objective rides as a pytree ARGUMENT — its reg/norm arrays, λ
+# included, are traced leaves, never HLO constants).
+# ---------------------------------------------------------------------------
+
+_jit_vg = jax.jit(lambda o, w, b: o.value_and_gradient(w, b))
+_jit_val = jax.jit(lambda o, w, b: o.value(w, b))
+_jit_hvp = jax.jit(lambda o, w, v, b: o.hessian_vector(w, v, b))
+_jit_hd = jax.jit(lambda o, w, b: o.hessian_diagonal(w, b))
+_jit_margins = jax.jit(lambda o, w, b: o.predict_margins(w, b))
+_jit_xdot_obj = jax.jit(lambda o, w, b: o.x_dot(w, b))
+_jit_xdot = jax.jit(lambda w, b: b.x_dot(w))
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _jit_vg_swept(o, W, b, lane_map):
+    return sweep_value_and_gradient(o, W, b, use_map=lane_map)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _jit_val_swept(o, W, b, lane_map):
+    return sweep_value(o, W, b, use_map=lane_map)
+
+
+@jax.jit
+def _swept_direction(PG, W, S_buf, Y_buf, Rho, head, count, l1):
+    """Per-lane two-loop recursion + safeguards as ONE device program
+    (the host-driven swept solver dispatches this once per iteration;
+    eagerly it would be ~2·m·L fancy-indexed ops per step).
+
+    Returns (D [L, d], Xi [L, d] | None): the per-lane descent
+    directions and, when ``l1`` is given (OWL-QN), the search orthants.
+    """
+    m, L, d = S_buf.shape
+    lanes = jnp.arange(L)
+    q = PG
+    alphas = []
+    for j in range(m):
+        idx = (head - 1 - j) % m
+        valid = j < count
+        s_j, y_j = S_buf[idx, lanes], Y_buf[idx, lanes]
+        a = Rho[idx, lanes] * jnp.sum(s_j * q, axis=-1)
+        a = jnp.where(valid, a, 0.0)
+        q = q - a[:, None] * y_j
+        alphas.append((a, idx, valid))
+    newest = (head - 1) % m
+    y_new = Y_buf[newest, lanes]
+    gamma = jnp.where(
+        count > 0,
+        1.0 / jnp.maximum(
+            Rho[newest, lanes] * jnp.sum(y_new * y_new, axis=-1),
+            _CURVATURE_EPS),
+        1.0,
+    )
+    r = gamma[:, None] * q
+    for a, idx, valid in reversed(alphas):
+        s_j, y_j = S_buf[idx, lanes], Y_buf[idx, lanes]
+        beta = Rho[idx, lanes] * jnp.sum(y_j * r, axis=-1)
+        upd = s_j * (a - beta)[:, None]
+        r = r + jnp.where(valid[:, None], upd, 0.0)
+    D = -r
+    Xi = None
+    if l1 is not None:
+        D = jnp.where(D * -PG > 0.0, D, 0.0)
+        Xi = jnp.where(W != 0.0, jnp.sign(W), jnp.sign(-PG))
+    bad = jnp.sum(PG * D, axis=-1) >= 0.0
+    D = jnp.where(bad[:, None], -PG, D)
+    return D, Xi
+
+
+@jax.jit
+def _swept_push(S_buf, Y_buf, Rho, head, count, s, y, good):
+    """Masked per-lane circular-buffer push of curvature pairs — one
+    device program per iteration."""
+    L = head.shape[0]
+    lanes = jnp.arange(L)
+    sy = jnp.sum(s * y, axis=-1)
+    S_buf = S_buf.at[head, lanes].set(
+        jnp.where(good[:, None], s, S_buf[head, lanes]))
+    Y_buf = Y_buf.at[head, lanes].set(
+        jnp.where(good[:, None], y, Y_buf[head, lanes]))
+    Rho = Rho.at[head, lanes].set(
+        jnp.where(good, 1.0 / jnp.maximum(sy, _CURVATURE_EPS),
+                  Rho[head, lanes]))
+    m = S_buf.shape[0]
+    head = jnp.where(good, (head + 1) % m, head)
+    count = jnp.where(good, jnp.minimum(count + 1, m), count)
+    return S_buf, Y_buf, Rho, head, count
+
+
 class ChunkedGLMObjective:
     """``GLMObjective`` surface over a ``ChunkedBatch``.
 
@@ -84,6 +193,10 @@ class ChunkedGLMObjective:
     (datasets that fit entirely set it ≥ n_chunks and pay the transfer
     once — the resident and streaming regimes are one code path);
     beyond it, chunks are re-placed each pass, double-buffered.
+
+    ``sweeps`` counts full chunk sweeps since construction — the
+    data-pass odometer the bench's ``sweep`` section reads to show the
+    L → 1 passes-per-iteration amortization.
     """
 
     def __init__(self, objective: GLMObjective, batch: ChunkedBatch,
@@ -91,6 +204,7 @@ class ChunkedGLMObjective:
         self.objective = objective
         self.batch = batch
         self.max_resident = max_resident
+        self.sweeps = 0
         self._cache: dict = {}
         inner = objective.replace(
             reg=RegularizationContext.none(), prior=None)
@@ -102,21 +216,12 @@ class ChunkedGLMObjective:
                 objective=inner, mesh=self._mesh)
         else:
             self._inner = inner
-        # One jitted program per method, shared by every congruent
-        # chunk.  The objective rides as a pytree ARGUMENT (not a
-        # closure) so its [dim] reg/norm arrays don't bake into the
-        # HLO as constants.
-        self._j_vg = jax.jit(lambda o, w, b: o.value_and_gradient(w, b))
-        self._j_val = jax.jit(lambda o, w, b: o.value(w, b))
-        self._j_hvp = jax.jit(lambda o, w, v, b: o.hessian_vector(w, v, b))
-        self._j_hd = jax.jit(lambda o, w, b: o.hessian_diagonal(w, b))
-        self._j_margins = jax.jit(
-            lambda o, w, b: o.predict_margins(w, b))
-        if self._mesh is not None:
-            self._j_xdot = jax.jit(
-                lambda w, b: self._inner.x_dot(w, b))
-        else:
-            self._j_xdot = jax.jit(lambda w, b: b.x_dot(w))
+        # Swept evaluations lane-loop (lax.map) instead of vmapping when
+        # the per-chunk program has no batching rule: GRR chunk plans
+        # (Pallas kernel) and shard_mapped mesh objectives.  The chunk
+        # still streams ONCE either way — the amortization is the
+        # transfer, not the read.
+        self._lane_map = batch.layout == "grr" or self._mesh is not None
 
     # -- chunk residency ---------------------------------------------------
 
@@ -135,6 +240,7 @@ class ChunkedGLMObjective:
     def _sweep(self, per_chunk, combine):
         """Stream all chunks through ``per_chunk``, double-buffered."""
         k = self.batch.n_chunks
+        self.sweeps += 1
         acc = None
         nxt = self._get(0)
         for i in range(k):
@@ -149,7 +255,7 @@ class ChunkedGLMObjective:
 
     def value(self, w: Array) -> Array:
         w = jnp.asarray(w, jnp.float32)
-        val = self._sweep(lambda b: self._j_val(self._inner, w, b),
+        val = self._sweep(lambda b: _jit_val(self._inner, w, b),
                           lambda a, x: a + x)
         val = val + self.objective.reg.l2_value(w)
         if self.objective.prior is not None:
@@ -159,7 +265,7 @@ class ChunkedGLMObjective:
     def value_and_gradient(self, w: Array) -> tuple[Array, Array]:
         w = jnp.asarray(w, jnp.float32)
         f, g = self._sweep(
-            lambda b: self._j_vg(self._inner, w, b),
+            lambda b: _jit_vg(self._inner, w, b),
             lambda a, x: (a[0] + x[0], a[1] + x[1]))
         reg = self.objective.reg
         f = f + reg.l2_value(w)
@@ -175,7 +281,7 @@ class ChunkedGLMObjective:
     def hessian_vector(self, w: Array, v: Array) -> Array:
         w = jnp.asarray(w, jnp.float32)
         v = jnp.asarray(v, jnp.float32)
-        hv = self._sweep(lambda b: self._j_hvp(self._inner, w, v, b),
+        hv = self._sweep(lambda b: _jit_hvp(self._inner, w, v, b),
                          lambda a, x: a + x)
         hv = hv + self.objective.reg.l2_hessian_vector(v)
         if self.objective.prior is not None:
@@ -184,18 +290,69 @@ class ChunkedGLMObjective:
 
     def hessian_diagonal(self, w: Array) -> Array:
         w = jnp.asarray(w, jnp.float32)
-        hd = self._sweep(lambda b: self._j_hd(self._inner, w, b),
+        hd = self._sweep(lambda b: _jit_hd(self._inner, w, b),
                          lambda a, x: a + x)
         hd = hd + self.objective.reg.l2_hessian_diagonal(w)
         if self.objective.prior is not None:
             hd = hd + self.objective.prior.hessian_diagonal()
         return hd
 
+    # -- swept (stacked λ-lane) surface ------------------------------------
+
+    def _lane_reg(self, W: Array, reg: SweptRegularization | None,
+                  method: str) -> Array:
+        """Per-lane L2/prior term via the named context method —
+        [L(, d)].  ``reg`` None applies the objective's own weight to
+        every lane."""
+        ctx = self.objective.reg
+        if reg is None:
+            out = jax.vmap(getattr(ctx, method))(W)
+        else:
+            out = jax.vmap(
+                lambda w, l2: getattr(ctx.replace(l2_weight=l2), method)(w)
+            )(W, reg.l2_weights)
+        return out
+
+    def value_swept(self, W: Array,
+                    reg: SweptRegularization | None = None) -> Array:
+        """[L, d] stacked lanes → [L] values from ONE chunk sweep."""
+        W = jnp.asarray(W, jnp.float32)
+        val = self._sweep(
+            lambda b: _jit_val_swept(self._inner, W, b, self._lane_map),
+            lambda a, x: a + x)
+        val = val + self._lane_reg(W, reg, "l2_value")
+        if self.objective.prior is not None:
+            val = val + jax.vmap(self.objective.prior.value)(W)
+        return val
+
+    def value_and_gradient_swept(
+        self, W: Array, reg: SweptRegularization | None = None,
+    ) -> tuple[Array, Array]:
+        """[L, d] stacked lanes → ([L], [L, d]) from ONE double-buffered
+        chunk sweep: the λ grid's L data passes collapse to one, since
+        the per-chunk partials are λ-independent and per-lane reg is
+        added here, outside the chunk loop."""
+        W = jnp.asarray(W, jnp.float32)
+        f, g = self._sweep(
+            lambda b: _jit_vg_swept(self._inner, W, b, self._lane_map),
+            lambda a, x: (a[0] + x[0], a[1] + x[1]))
+        f = f + self._lane_reg(W, reg, "l2_value")
+        g = g + self._lane_reg(W, reg, "l2_gradient")
+        if self.objective.prior is not None:
+            f = f + jax.vmap(self.objective.prior.value)(W)
+            g = g + jax.vmap(self.objective.prior.gradient)(W)
+        return f, g
+
     def _per_example(self, fn) -> np.ndarray:
         """Concatenate a per-chunk per-example quantity over all chunks
         — [n] host array (n·f32 stays bounded; only plans/features were
-        too big for residency)."""
-        outs = []
+        too big for residency).  Each chunk's D2H copy is STARTED
+        asynchronously as soon as its compute is dispatched, so copies
+        overlap the next chunk's compute; the blocking ``np.asarray``
+        conversions happen once at the end, when most bytes have
+        already landed (a serial per-chunk ``np.asarray`` would fence
+        every chunk)."""
+        pending = []
         k = self.batch.n_chunks
         nxt = self._get(0)
         for i in range(k):
@@ -203,21 +360,30 @@ class ChunkedGLMObjective:
             if i + 1 < k:
                 nxt = self._get(i + 1)
             m = fn(cur)
+            try:
+                m.copy_to_host_async()
+            except AttributeError:
+                pass
             lo, hi = self.batch.chunk_slice(i)
-            outs.append(np.asarray(m)[: hi - lo])
-        return np.concatenate(outs) if outs else np.zeros(0, np.float32)
+            pending.append((m, hi - lo))
+        if not pending:
+            return np.zeros(0, np.float32)
+        return np.concatenate([np.asarray(m)[:rows] for m, rows in pending])
 
     def predict_margins(self, w: Array) -> np.ndarray:
         """Per-example margins (offsets included) over all chunks."""
         w = jnp.asarray(w, jnp.float32)
         return self._per_example(
-            lambda b: self._j_margins(self._inner, w, b))
+            lambda b: _jit_margins(self._inner, w, b))
 
     def x_dot(self, w: Array) -> np.ndarray:
         """Raw X·w per example (offset-free scoring, the GAME
         ``CoordinateDataScores`` convention)."""
         w = jnp.asarray(w, jnp.float32)
-        return self._per_example(lambda b: self._j_xdot(w, b))
+        if self._mesh is not None:
+            return self._per_example(
+                lambda b: _jit_xdot_obj(self._inner, w, b))
+        return self._per_example(lambda b: _jit_xdot(w, b))
 
 
 def streaming_lbfgs_solve(
@@ -225,11 +391,19 @@ def streaming_lbfgs_solve(
     w0: Array,
     config: OptimizerConfig = OptimizerConfig(),
     l1_weight=None,
+    value_fn=None,
 ) -> OptimizationResult:
     """Host-driven L-BFGS / OWL-QN over an expensive (streamed)
     ``value_and_grad`` — the chunked mirror of ``optim.lbfgs
     .lbfgs_solve`` (same math, same convergence semantics; the outer
     loop is Python because each evaluation swaps chunks through HBM).
+
+    ``value_fn`` (optional, ``w → f``) makes backtracking cheaper: the
+    FIRST line-search trial keeps the fused value+gradient pass (the
+    steady state accepts α=1, so the common case stays one pass per
+    iteration), later trials run value-only passes, and the gradient is
+    computed once on the accepted point — every rejected backtrack
+    stops paying the gradient half of its pass.
     """
     m = config.lbfgs_memory
     w = jnp.asarray(w0, jnp.float32)
@@ -237,11 +411,15 @@ def streaming_lbfgs_solve(
     l1 = (jnp.broadcast_to(jnp.asarray(l1_weight, w.dtype), w.shape)
           if owlqn else None)
 
+    def l1_term(w_):
+        return jnp.sum(l1 * jnp.abs(w_)) if owlqn else 0.0
+
     def full_value_grad(w_):
         f, g = value_and_grad(w_)
-        if owlqn:
-            f = f + jnp.sum(l1 * jnp.abs(w_))
-        return f, g
+        return f + l1_term(w_), g
+
+    full_value = (None if value_fn is None
+                  else (lambda w_: value_fn(w_) + l1_term(w_)))
 
     def pgrad(g_, w_):
         return _pseudo_gradient(g_, w_, l1) if owlqn else g_
@@ -299,15 +477,28 @@ def streaming_lbfgs_solve(
         # progress is below f32 measurement precision and the solve
         # stall-terminates rather than grinds.
         alpha = 1.0
-        for _ in range(config.ls_max_steps + 1):
+        g_try = None
+        for step in range(config.ls_max_steps + 1):
             w_try = w + alpha * d
             if owlqn:
                 w_try = jnp.where(jnp.sign(w_try) == xi, w_try, 0.0)
-            f_try, g_try = full_value_grad(w_try)
+            if step == 0 or full_value is None:
+                f_try, g_try = full_value_grad(w_try)
+            else:
+                f_try, g_try = full_value(w_try), None
             if float(f_try) <= float(
                     f + config.ls_c1 * jnp.vdot(pg, w_try - w)):
                 break
             alpha *= config.ls_shrink
+        if g_try is None and float(f_try) < float(f):
+            # Accepted (or committed) a value-only trial that will
+            # take effect: one fused pass recovers its gradient.  A
+            # stall (no strict decrease — the common terminal
+            # iteration) keeps the old state, so its gradient would be
+            # discarded work: skip the pass and terminate below.
+            f_try, g_try = full_value_grad(w_try)
+        elif g_try is None:
+            g_try = g   # stalled: state is not committed below
         w_new, f_new, g_new = w_try, f_try, g_try
         ls_ok = float(f_new) < float(f)
         if ls_ok:
@@ -345,5 +536,197 @@ def streaming_lbfgs_solve(
         grad_norm=jnp.linalg.norm(pg_f),
         iterations=jnp.asarray(it, jnp.int32),
         converged=jnp.asarray(converged),
+        tracker=tracker,
+    )
+
+
+def streaming_lbfgs_solve_swept(
+    value_and_grad_swept,
+    value_swept,
+    w0s: Array,
+    config: OptimizerConfig = OptimizerConfig(),
+    l1_weights=None,
+) -> OptimizationResult:
+    """Host-driven batched-lane L-BFGS / OWL-QN: the whole λ grid as
+    ONE streamed solve.
+
+    The chunked mirror of ``optim.lbfgs.lbfgs_solve_swept``: all
+    per-lane state (coefficients, (s, y) circular buffers, line-search
+    step sizes, convergence flags) carries a leading lane axis L and
+    every update is masked per lane, so converged lanes coast while
+    stragglers finish — and EVERY objective evaluation is one shared
+    chunk sweep feeding all L lanes (``value_and_grad_swept``:
+    ``W [L, d] → (F [L], G [L, d])`` including per-lane smooth reg).
+    Data passes per solver iteration drop from L (sequential fits) to
+    ~1: one fused value+gradient sweep when every searching lane
+    accepts α=1 (the steady state), plus one shared value-only sweep
+    per extra backtracking trial (``value_swept``) and one gradient
+    recovery sweep on iterations where some lane accepted late.
+
+    ``l1_weights``: None, [L] per-lane scalars, or [L, d] per-lane
+    vectors — any non-None activates OWL-QN semantics on every lane.
+
+    Returns a batched ``OptimizationResult`` (leading dim L), like a
+    vmapped resident solve.
+    """
+    m = config.lbfgs_memory
+    W = jnp.asarray(w0s, jnp.float32)
+    L, d = W.shape
+    owlqn = l1_weights is not None
+    if owlqn:
+        l1 = jnp.asarray(l1_weights, W.dtype)
+        l1 = jnp.broadcast_to(l1.reshape(L, -1), (L, d))
+
+    def l1_term(W_):
+        return jnp.sum(l1 * jnp.abs(W_), axis=-1) if owlqn else 0.0
+
+    def full_vg(W_):
+        F_, G_ = value_and_grad_swept(W_)
+        return F_ + l1_term(W_), G_
+
+    def full_val(W_):
+        return value_swept(W_) + l1_term(W_)
+
+    def pgrad(G_, W_):
+        return _pseudo_gradient(G_, W_, l1) if owlqn else G_
+
+    F, G = full_vg(W)
+    PG = pgrad(G, W)
+    g0_norm = jnp.linalg.norm(PG, axis=-1)                     # [L]
+    done = grad_converged(g0_norm, g0_norm, config.tolerance)  # [L]
+    converged = done
+    iters = jnp.zeros((L,), jnp.int32)
+
+    S_buf = jnp.zeros((m, L, d), W.dtype)
+    Y_buf = jnp.zeros((m, L, d), W.dtype)
+    Rho = jnp.zeros((m, L), W.dtype)
+    head = jnp.zeros((L,), jnp.int32)
+    count = jnp.zeros((L,), jnp.int32)
+
+    t_vals = jnp.full((L, config.max_iters + 1), jnp.nan, jnp.float32)
+    t_gn = jnp.full((L, config.max_iters + 1), jnp.nan, jnp.float32)
+    if config.track_states:
+        t_vals = t_vals.at[:, 0].set(F)
+        t_gn = t_gn.at[:, 0].set(g0_norm)
+
+    it = 0
+    while not bool(jnp.all(done)) and it < config.max_iters:
+        active = jnp.logical_not(done)
+        PG = pgrad(G, W)
+
+        # Per-lane two-loop recursion + OWL-QN projections, one
+        # dispatch (module-level jit).
+        D, Xi = _swept_direction(PG, W, S_buf, Y_buf, Rho, head, count,
+                                 l1 if owlqn else None)
+
+        def project(W_try):
+            if not owlqn:
+                return W_try
+            return jnp.where(jnp.sign(W_try) == Xi, W_try, 0.0)
+
+        def armijo(W_t, F_t):
+            return F_t <= F + config.ls_c1 * jnp.sum(
+                PG * (W_t - W), axis=-1)
+
+        # Batched backtracking: one SHARED sweep per trial serves every
+        # still-searching lane.  Trial 0 is the fused value+gradient
+        # sweep (steady state: all lanes accept α=1 → one pass per
+        # iteration for the whole grid); later trials are value-only.
+        alpha = jnp.ones((L,), W.dtype)
+        W_try = project(W + alpha[:, None] * D)
+        F1, G1 = full_vg(W_try)
+        ok = armijo(W_try, F1)
+        accepted = ok | done
+        commit0 = ok & active
+        W_acc = jnp.where(commit0[:, None], W_try, W)
+        F_acc = jnp.where(commit0, F1, F)
+        G_acc = jnp.where(commit0[:, None], G1, G)
+        grad_known = accepted          # lanes whose G_acc is current
+        W_last, F_last = W_try, F1
+        for _ in range(config.ls_max_steps):
+            if bool(jnp.all(accepted)):
+                break
+            alpha = jnp.where(accepted, alpha, alpha * config.ls_shrink)
+            W_try = project(W + alpha[:, None] * D)
+            # Accepted lanes re-evaluate at their committed point (the
+            # sweep is shared; their rows are simply ignored).
+            W_eval = jnp.where(accepted[:, None], W_acc, W_try)
+            F_eval = full_val(W_eval)
+            ok = armijo(W_eval, F_eval) & jnp.logical_not(accepted)
+            W_acc = jnp.where(ok[:, None], W_try, W_acc)
+            F_acc = jnp.where(ok, F_eval, F_acc)
+            accepted = accepted | ok
+            still = jnp.logical_not(accepted)
+            W_last = jnp.where(still[:, None], W_try, W_last)
+            F_last = jnp.where(still, F_eval, F_last)
+        # Never-accepted lanes commit the LAST trial (resident
+        # semantics); only a strict decrease counts as progress below.
+        hold = accepted | jnp.logical_not(active)
+        W_new = jnp.where(hold[:, None], W_acc, W_last)
+        F_new = jnp.where(hold, F_acc, F_last)
+        # Gradient recovery is only owed to lanes that BOTH moved past
+        # trial 0 and will actually commit (strict decrease) — a lane
+        # that exhausted its backtracks without progress stalls and
+        # keeps its old state, so paying a sweep for its gradient would
+        # be discarded work (stall iterations are common right at each
+        # lane's convergence edge).
+        need_grad = (jnp.logical_not(grad_known | done)
+                     & (F_new < F) & active)
+        if bool(jnp.any(need_grad)):
+            # One shared sweep recovers every lane's gradient at its
+            # committed point.
+            F_new, G_new = full_vg(W_new)
+        else:
+            G_new = G_acc
+
+        ls_ok = (F_new < F) & active
+        s = W_new - W
+        y = G_new - G
+        sy = jnp.sum(s * y, axis=-1)
+        good = ls_ok & (
+            sy > _CURVATURE_EPS * jnp.linalg.norm(s, axis=-1)
+            * jnp.linalg.norm(y, axis=-1))
+        S_buf, Y_buf, Rho, head, count = _swept_push(
+            S_buf, Y_buf, Rho, head, count, s, y, good)
+
+        PG_new = pgrad(G_new, W_new)
+        g_norm = jnp.linalg.norm(PG_new, axis=-1)
+        conv = jnp.logical_or(
+            grad_converged(g_norm, g0_norm, config.tolerance),
+            loss_converged(F_new, F, config.rel_tolerance),
+        )
+        stalled = jnp.logical_not(ls_ok) & active
+        it += 1
+        iters = jnp.where(active, it, iters)
+        if config.track_states:
+            t_vals = t_vals.at[:, it].set(
+                jnp.where(active, F_new, t_vals[:, it]))
+            t_gn = t_gn.at[:, it].set(
+                jnp.where(active, g_norm, t_gn[:, it]))
+        # Commit per lane: line-search progress updates state; stalled
+        # lanes keep theirs (and terminate, as in the resident solver).
+        W = jnp.where(ls_ok[:, None], W_new, W)
+        F = jnp.where(ls_ok, F_new, F)
+        G = jnp.where(ls_ok[:, None], G_new, G)
+        finished = active & (conv | stalled)
+        converged = converged | finished
+        done = done | finished
+        logger.info(
+            "streaming swept lbfgs iter %d: %d/%d lanes done, "
+            "f_best=%.6f", it, int(jnp.sum(done)), L,
+            float(jnp.min(F)))
+
+    PG_f = pgrad(G, W)
+    tracker = StatesTracker(
+        values=t_vals, grad_norms=t_gn,
+        count=(iters + 1 if config.track_states
+               else jnp.zeros((L,), jnp.int32)),
+    )
+    return OptimizationResult(
+        w=W,
+        value=F,
+        grad_norm=jnp.linalg.norm(PG_f, axis=-1),
+        iterations=iters,
+        converged=converged,
         tracker=tracker,
     )

@@ -61,6 +61,32 @@ def test_bench_cached_section_records_warm_vs_cold(tmp_path):
     assert cached["parity_ok"] is True
 
 
+def test_bench_sweep_section_contract(tmp_path):
+    """`--section sweep` keeps the budget/JSON-last-line contract and
+    records the batched-vs-sequential λ-sweep measurement: wall times,
+    speedup, coefficient parity, and the phase breakdown showing the
+    data-pass amortization (passes per grid step: ~L·x → ~2)."""
+    proc = _run_bench(tmp_path, "--section", "sweep",
+                      "--budget-s", "240", *_TINY)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert rec["section"] == "sweep"
+    assert rec.get("errors") is None
+    sweep = rec["sweep"]
+    assert sweep["lanes"] >= 4
+    assert sweep["batched_s"] > 0 and sweep["sequential_s"] > 0
+    assert sweep["speedup"] is not None
+    assert sweep["parity_max_dw"] < 1e-3
+    ph = sweep["phases"]
+    # The tentpole invariant: one shared chunk stream feeds all lanes,
+    # so the batched grid pays a small constant number of passes per
+    # grid step while sequential pays ~L of them.
+    assert ph["batched"]["data_passes"] < ph["sequential"]["data_passes"]
+    assert ph["batched"]["passes_per_grid_step"] <= 3.0
+    assert sweep["pass_amortization"] >= 2.0
+
+
 def test_bench_zero_budget_still_emits_json(tmp_path):
     """A hopeless budget skips every section but the process still
     exits 0 with one parseable JSON line recording the skips."""
