@@ -70,9 +70,20 @@ import numpy as np
 V5E_PEAK_GBPS = 819.0
 
 DEFAULT_SECTIONS = ("etl", "cached", "grr", "segment_sum", "colmajor")
-ALL_SECTIONS = DEFAULT_SECTIONS + ("powerlaw", "chunked", "sweep")
+ALL_SECTIONS = DEFAULT_SECTIONS + ("powerlaw", "chunked", "sweep",
+                                   "stream")
 DEFAULT_BUDGET_S = 840.0
 DEFAULT_N, DEFAULT_D, DEFAULT_K = 1_000_000, 100_000, 30
+
+# Out-of-core stream section shape: the chunk total must dwarf the
+# host window (≥ 6×; 24/2 = 12×) for the RSS bound to be a real claim
+# — and finer chunks tighten the spilled arm's floor (window, prefetch
+# queue, and in-flight temporaries all scale with CHUNK size, the
+# resident arm with the DATASET).
+STREAM_CHUNKS = 24
+STREAM_WINDOW = 2
+STREAM_DEPTH = 2
+STREAM_SWEEPS = 5
 
 # λ-sweep section shape: lanes × solver-iteration cap (kept static so
 # the batched and sequential arms solve the identical problem set).
@@ -96,7 +107,74 @@ SECTION_EST_S = {
     # L+1 streamed solves over 4 ELL chunks (~(L·⌀16 + ~25) passes at
     # ~1.5 s/pass at the full shape) + chunk ETL.
     "sweep": 420.0,
+    # Two chunk ETLs (one spilling to disk) + 2×(1 warm + STREAM_SWEEPS
+    # timed) full-data passes.
+    "stream": 420.0,
 }
+
+
+def _peak_rss_mb() -> float:
+    """Process high-water RSS (ru_maxrss is KB on Linux, bytes on mac)."""
+    import resource
+
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    if sys.platform == "darwin":
+        peak //= 1024
+    return peak / 1024.0
+
+
+def _current_rss_mb(field: str = "VmRSS") -> float | None:
+    """Instantaneous RSS from /proc (Linux); None elsewhere.
+    ``field="RssAnon"`` reads the anonymous-only portion — the
+    spilled chunk window and its device aliases are FILE-backed
+    (memory-mapped, reclaimable under pressure), so anon RSS is the
+    honest can-this-OOM number for the out-of-core arm."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith(field + ":"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return None
+
+
+class _RssSampler:
+    """Peak CURRENT RSS over a window, sampled at ~50 Hz — unlike
+    ru_maxrss (a process-lifetime high-water mark) this attributes a
+    peak to ONE bench arm, which is what the spilled-vs-resident
+    comparison needs.  Falls back to ru_maxrss when /proc is absent."""
+
+    def __init__(self):
+        import threading
+
+        self._stop = threading.Event()
+        self._peak = 0.0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.is_set():
+            cur = _current_rss_mb()
+            if cur is not None:
+                self._peak = max(self._peak, cur)
+            self._stop.wait(0.02)
+
+    def __enter__(self):
+        cur = _current_rss_mb()
+        if cur is not None:
+            self._peak = cur
+            self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join()
+        return False
+
+    @property
+    def peak_mb(self) -> float:
+        return self._peak if self._peak else _peak_rss_mb()
 
 
 def _make_ell(n: int, d: int, k: int, seed: int = 0):
@@ -154,6 +232,7 @@ class BenchContext:
     def __init__(self, args):
         self.n, self.d, self.k = args.n, args.d, args.k
         self.cache_dir = args.cache_dir
+        self.no_compile_cache = args.no_compile_cache
         self.deadline = time.time() + args.budget_s
         self.budget_s = args.budget_s
         self.record: dict = {}
@@ -173,6 +252,10 @@ class BenchContext:
 
     def estimate(self, section: str) -> float:
         est = SECTION_EST_S[section] * self.scale
+        if section == "stream":
+            # Two subprocess arms pay a fixed jax-import + compile cost
+            # each, regardless of shape.
+            est += 60.0
         # Sections that need the GRR plan pay a COLD build first when
         # neither a resident pair nor a cache file exists (e.g. etl was
         # skipped or never ran) — charge it, or a section admitted
@@ -635,6 +718,199 @@ def section_sweep(ctx: BenchContext) -> None:
           f"{per_step_s:.1f} -> {per_step_b:.1f}", file=sys.stderr)
 
 
+def stream_arm_main(args) -> int:
+    """One arm of the ``stream`` section, run in its OWN process
+    (``bench.py --stream-arm spilled|resident``): a shared process
+    would let the first arm's freed glibc arenas absorb the second
+    arm's allocations and understate its RSS — per-arm ``ru_maxrss``
+    is the honest high-water mark.  Emits one JSON line (the section
+    contract, one level down) and writes the final gradient next to
+    the cache dir for the parent's cross-arm parity check."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.chunked_batch import build_chunked_batch
+    from photon_ml_tpu.data.normalization import NormalizationContext
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optim.streaming import ChunkedGLMObjective
+
+    arm = args.stream_arm
+    n, d, k = args.n, args.d, args.k
+    cols, vals, labels = _make_ell(n, d, k)
+    rows_sp = SparseRows.from_flat(
+        np.arange(n + 1, dtype=np.int64) * k,
+        cols.reshape(-1).astype(np.int64), vals.reshape(-1))
+    obj = GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=RegularizationContext.l2(1.0),
+        norm=NormalizationContext.identity(),
+    )
+    w0 = jnp.asarray(
+        np.random.default_rng(1).normal(0, 0.1, d), jnp.float32)
+    base_mb = _current_rss_mb()   # raw data + runtime, pre-chunk-ETL
+    base_anon_mb = _current_rss_mb("RssAnon")
+
+    t0 = time.time()
+    if arm == "spilled":
+        cb = build_chunked_batch(
+            rows_sp, d, labels, n_chunks=STREAM_CHUNKS, layout="ell",
+            spill_dir=os.path.join(args.cache_dir, "spill"),
+            host_max_resident=STREAM_WINDOW)
+        cobj = ChunkedGLMObjective(obj, cb, max_resident=0,
+                                   prefetch_depth=STREAM_DEPTH)
+    else:
+        cb = build_chunked_batch(rows_sp, d, labels,
+                                 n_chunks=STREAM_CHUNKS, layout="ell")
+        cobj = ChunkedGLMObjective(obj, cb,
+                                   max_resident=STREAM_CHUNKS)
+    etl_s = time.time() - t0
+    jax.block_until_ready(cobj.value_and_gradient(w0)[1])   # compile
+    times = []
+    # Steady-state RSS is sampled over the TIMED sweeps only:
+    # ru_maxrss spans the whole arm and the one-time XLA compile spike
+    # can set both arms' high-water, masking the training-regime
+    # difference the section exists to measure.
+    g = None
+    with _RssSampler() as rss:
+        for _ in range(STREAM_SWEEPS):
+            # Fence every pass — the streaming solver syncs per
+            # evaluation (the line search reads the value on host).
+            t0 = time.time()
+            g = cobj.value_and_gradient(w0)[1]
+            jax.block_until_ready(g)
+            times.append(time.time() - t0)
+    # Median, not mean: single passes on a small shared host jitter
+    # ±20% and one descheduled pass would swing the cross-arm ratio.
+    pass_s = float(np.median(times))
+    # The last timed sweep's gradient IS the parity artifact — no
+    # extra data pass to capture it.
+    g = np.asarray(g)
+    np.save(os.path.join(args.cache_dir, f"stream_grad_{arm}.npy"), g)
+
+    peak = _peak_rss_mb()
+    sweep_peak = rss.peak_mb
+    anon = _current_rss_mb("RssAnon")   # steady state; None pre-4.5
+    rec = {
+        "arm": arm,
+        "etl_s": round(etl_s, 1),
+        "pass_ms": round(pass_s * 1e3, 1),
+        "pass_ms_all": [round(t * 1e3, 1) for t in times],
+        "examples_per_sec": round(n / pass_s, 1),
+        "peak_rss_mb": round(peak, 1),
+        "sweep_peak_rss_mb": round(sweep_peak, 1),
+        # RSS attributable to the chunk tier at steady state: the
+        # sweep-window peak minus the raw-data baseline snapshotted
+        # before the chunk build.
+        "rss_delta_mb": (round(sweep_peak - base_mb, 1)
+                         if base_mb is not None else None),
+        # Anonymous-only growth (kernel >= 4.5): the spilled arm's
+        # window and device aliases are file-backed (reclaimable), so
+        # this is the can-this-OOM working set.
+        "anon_delta_mb": (round(anon - base_anon_mb, 1)
+                          if anon is not None
+                          and base_anon_mb is not None else None),
+    }
+    if arm == "spilled":
+        store = cb.store
+        rec.update({
+            "peak_live_chunks": store.peak_resident,
+            "disk_loads": store.loads,
+            "window_hits": store.hits,
+            "spill_files_mb": round(sum(
+                os.path.getsize(store.path(i))
+                for i in range(STREAM_CHUNKS) if store.has(i)) / 1e6, 1),
+        })
+    print(json.dumps(rec))
+    return 0
+
+
+def section_stream(ctx: BenchContext) -> None:
+    """Out-of-core streaming regime (ISSUE 3 tentpole measurement):
+    the SAME full-data value+gradient sweeps run twice — once with the
+    disk-backed chunk store (``spill_dir``, host window
+    ``STREAM_WINDOW`` of ``STREAM_CHUNKS`` chunks, async
+    disk→host→device prefetch) and once all-resident — each arm in
+    its own subprocess so peak host RSS is measured per arm
+    (``ru_maxrss``; one shared process would hide the second arm's
+    growth in the first arm's freed allocator arenas).  The claims
+    under test: host RSS bounded by the window (chunks total 6× the
+    window at this section's shape) while wall-clock per sweep stays
+    within ~1.3× of all-resident (prefetch hides the disk tier)."""
+    import shutil
+    import subprocess
+
+    spill_dir = os.path.join(ctx.cache_dir, "spill")
+    shutil.rmtree(spill_dir, ignore_errors=True)  # honest cold spill ETL
+
+    def run_arm(arm: str) -> dict:
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--stream-arm", arm, "--n", str(ctx.n), "--d", str(ctx.d),
+             "--k", str(ctx.k), "--cache-dir", ctx.cache_dir]
+            + (["--no-compile-cache"] if ctx.no_compile_cache else []),
+            capture_output=True, text=True,
+            timeout=max(60.0, ctx.remaining()),
+        )
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            raise RuntimeError(f"stream arm {arm!r} failed "
+                               f"(rc={proc.returncode}): "
+                               f"{proc.stderr[-500:]}")
+        rec = json.loads(
+            [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+        rec["arm_wall_s"] = round(time.time() - t0, 1)
+        return rec
+
+    spilled = run_arm("spilled")
+    resident = run_arm("resident")
+    g_s = np.load(os.path.join(ctx.cache_dir, "stream_grad_spilled.npy"))
+    g_r = np.load(os.path.join(ctx.cache_dir,
+                               "stream_grad_resident.npy"))
+    parity = float(np.max(np.abs(g_s - g_r)))
+
+    def ratio(a, b):
+        # Explicit None/zero-divisor guard: a legitimate 0.0 numerator
+        # (a flat arm) must report 0.0, not null.
+        if a is None or b is None or b == 0:
+            return None
+        return round(a / b, 2)
+
+    ctx.record["stream"] = {
+        "n_chunks": STREAM_CHUNKS,
+        "host_max_resident": STREAM_WINDOW,
+        "prefetch_depth": STREAM_DEPTH,
+        "sweeps_timed": STREAM_SWEEPS,
+        "layout": "ell",
+        "spilled": spilled,
+        "resident": resident,
+        # The two acceptance numbers: how much smaller the spilled
+        # arm's training working set is (chunk-tier RSS growth over
+        # the shared raw-data baseline), and the wall-clock cost of
+        # streaming from disk.
+        "rss_delta_ratio": ratio(resident["rss_delta_mb"],
+                                 spilled["rss_delta_mb"]),
+        "anon_delta_ratio": ratio(resident["anon_delta_mb"],
+                                  spilled["anon_delta_mb"]),
+        "peak_rss_ratio": ratio(resident["peak_rss_mb"],
+                                spilled["peak_rss_mb"]),
+        "pass_time_ratio": ratio(spilled["pass_ms"],
+                                 resident["pass_ms"]),
+        "grad_parity_max": parity,
+    }
+    s = ctx.record["stream"]
+    print(f"stream: spilled {spilled['pass_ms']} ms/pass (peak RSS "
+          f"{spilled['peak_rss_mb']} MB, Δ{spilled['rss_delta_mb']} MB,"
+          f" window {spilled['peak_live_chunks']}/{STREAM_CHUNKS} "
+          f"chunks) vs resident {resident['pass_ms']} ms/pass (peak "
+          f"{resident['peak_rss_mb']} MB, Δ{resident['rss_delta_mb']} "
+          f"MB); time ratio {s['pass_time_ratio']}x, RSS-delta ratio "
+          f"{s['rss_delta_ratio']}x", file=sys.stderr)
+
+
 SECTION_FNS = {
     "etl": section_etl,
     "cached": section_cached,
@@ -644,6 +920,7 @@ SECTION_FNS = {
     "powerlaw": section_powerlaw,
     "chunked": section_chunked,
     "sweep": section_sweep,
+    "stream": section_stream,
 }
 
 
@@ -708,6 +985,10 @@ def main(argv: list[str] | None = None) -> int:
                         "path, so repeated driver runs hit warm")
     p.add_argument("--no-compile-cache", action="store_true",
                    help="do not enable the persistent XLA cache")
+    p.add_argument("--stream-arm", choices=("spilled", "resident"),
+                   default=None,
+                   help="internal: run ONE arm of the stream section "
+                        "in this process (per-arm peak-RSS isolation)")
     args = p.parse_args(argv)
     if args.cache_dir is None:
         # Per-user default: a fixed shared-/tmp path would let another
@@ -727,6 +1008,9 @@ def main(argv: list[str] | None = None) -> int:
         from photon_ml_tpu.cache import enable_compilation_cache
 
         enable_compilation_cache(args.cache_dir)
+
+    if args.stream_arm:
+        return stream_arm_main(args)
 
     import jax
 
@@ -748,6 +1032,12 @@ def main(argv: list[str] | None = None) -> int:
         except Exception as e:  # record, keep the run parseable
             traceback.print_exc()
             ctx.errors[s] = f"{type(e).__name__}: {e}"
+        finally:
+            # Memory trajectory alongside wall-clock: the process
+            # high-water RSS after each section (monotone — a jump
+            # names the section that caused it).
+            ctx.record.setdefault("peak_rss_mb", {})[s] = round(
+                _peak_rss_mb(), 1)
 
     out = _finalize(ctx, platform)
     if args.section and len(sections) == 1:

@@ -117,6 +117,19 @@ def main(argv=None):
                     help="chunks kept live in HBM across passes (set "
                          ">= n/chunk_rows when the compact layout fits "
                          "— transfer then happens once)")
+    ap.add_argument("--spill-dir", default=None,
+                    help="out-of-core chunk store (data/chunk_store.py):"
+                         " chunk batches spill to disk here and only "
+                         "--host-resident decoded chunks stay in host "
+                         "RAM — breaks the host-RAM wall the same way "
+                         "--chunked breaks HBM's (default also "
+                         "$PHOTON_ML_TPU_SPILL_DIR)")
+    ap.add_argument("--host-resident", type=int, default=2,
+                    help="decoded chunks kept live in host RAM when "
+                         "spilling (the LRU window)")
+    ap.add_argument("--prefetch-depth", type=int, default=2,
+                    help="chunks prefetched disk->host->device ahead "
+                         "of compute when spilling (0 = synchronous)")
     ap.add_argument("--out", default=None, help="also write the JSON here")
     args = ap.parse_args(argv)
 
@@ -163,6 +176,9 @@ def main(argv=None):
         chunk_rows=args.chunked,
         chunk_layout=args.chunk_layout,
         chunk_max_resident=args.chunk_resident,
+        spill_dir=args.spill_dir,
+        host_max_resident=args.host_resident,
+        prefetch_depth=args.prefetch_depth,
     )
     est = GameEstimator(cfg)
     with log.timed("fit"):
@@ -190,6 +206,9 @@ def main(argv=None):
             "chunk_rows": args.chunked,
             "layout": args.chunk_layout,
             "max_resident": args.chunk_resident,
+            "spill_dir": args.spill_dir,
+            "host_max_resident": args.host_resident,
+            "prefetch_depth": args.prefetch_depth,
         }),
     }
     line = json.dumps(out)

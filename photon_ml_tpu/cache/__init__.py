@@ -35,6 +35,7 @@ build (tested).
 
 from photon_ml_tpu.cache.compile_cache import enable_compilation_cache
 from photon_ml_tpu.cache.plan_cache import (
+    atomic_savez,
     dataset_fingerprint,
     load_plan,
     plan_cache_path,
@@ -43,6 +44,7 @@ from photon_ml_tpu.cache.plan_cache import (
 )
 
 __all__ = [
+    "atomic_savez",
     "dataset_fingerprint",
     "enable_compilation_cache",
     "load_plan",

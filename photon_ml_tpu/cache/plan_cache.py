@@ -174,19 +174,12 @@ def _decode_node(meta, prefix: str, arrays, place=None):
 # ---------------------------------------------------------------------------
 
 
-def save_plan(path: str, plan) -> None:
-    """Serialize a plan (or list of plans — the sharded builder's
-    output) to ``path`` atomically.  Leaves must be host-reachable
-    (numpy or device arrays; device leaves are pulled back — the
-    in-repo builders save from their host copies, so no pull happens
-    on the production path)."""
-    arrays: dict = {}
-    if isinstance(plan, (list, tuple)):
-        meta = {"kind": "list",
-                "items": [_encode_node(p, f"s{i}.", arrays)
-                          for i, p in enumerate(plan)]}
-    else:
-        meta = _encode_node(plan, "", arrays)
+def atomic_savez(path: str, meta, arrays: dict) -> None:
+    """Write one uncompressed ``.npz`` holding ``arrays`` plus a JSON
+    ``__meta__`` member, atomically (tmp sibling + ``os.replace``) —
+    the shared write primitive of the plan cache AND the disk-backed
+    chunk store (``data.chunk_store``): readers never see a partial
+    file, and a crashed writer leaves at most a ``.tmp`` orphan."""
     os.makedirs(os.path.dirname(path), exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
                                suffix=".tmp")
@@ -201,6 +194,22 @@ def save_plan(path: str, plan) -> None:
         except OSError:
             pass
         raise
+
+
+def save_plan(path: str, plan) -> None:
+    """Serialize a plan (or list of plans — the sharded builder's
+    output) to ``path`` atomically.  Leaves must be host-reachable
+    (numpy or device arrays; device leaves are pulled back — the
+    in-repo builders save from their host copies, so no pull happens
+    on the production path)."""
+    arrays: dict = {}
+    if isinstance(plan, (list, tuple)):
+        meta = {"kind": "list",
+                "items": [_encode_node(p, f"s{i}.", arrays)
+                          for i, p in enumerate(plan)]}
+    else:
+        meta = _encode_node(plan, "", arrays)
+    atomic_savez(path, meta, arrays)
 
 
 def load_plan(path: str, place=None):

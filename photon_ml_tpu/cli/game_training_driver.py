@@ -244,10 +244,28 @@ def main(argv: list[str] | None = None) -> dict:
                         help="training config JSON file")
     parser.add_argument("--output-dir", default=None,
                         help="override config output_dir")
+    parser.add_argument("--spill-dir", default=None,
+                        help="override config spill_dir: out-of-core "
+                             "chunk store directory (default also "
+                             "$PHOTON_ML_TPU_SPILL_DIR)")
+    parser.add_argument("--host-max-resident", type=int, default=None,
+                        help="override config host_max_resident: "
+                             "decoded chunks kept live in host RAM "
+                             "when spilling")
+    parser.add_argument("--prefetch-depth", type=int, default=None,
+                        help="override config prefetch_depth: chunks "
+                             "prefetched disk->host->device ahead of "
+                             "compute (0 disables the thread)")
     args = parser.parse_args(argv)
     config = load_training_config(args.config)
     if args.output_dir:
         config.output_dir = args.output_dir
+    if args.spill_dir is not None:
+        config.spill_dir = args.spill_dir
+    if args.host_max_resident is not None:
+        config.host_max_resident = args.host_max_resident
+    if args.prefetch_depth is not None:
+        config.prefetch_depth = args.prefetch_depth
     return run(config)
 
 

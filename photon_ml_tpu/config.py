@@ -204,6 +204,21 @@ class TrainingConfig:
     chunk_rows: int | None = None
     chunk_layout: str = "AUTO"
     chunk_max_resident: int = 1
+    # Out-of-core chunk store (data/chunk_store.py): spill_dir (default
+    # $PHOTON_ML_TPU_SPILL_DIR; None = chunks stay host-resident)
+    # activates the disk tier — chunk batches spill to atomic
+    # content-keyed .npz files at build time, at most host_max_resident
+    # decoded chunks stay live in host RAM (memory-mapped, LRU), and a
+    # background prefetch thread overlaps disk read → host staging →
+    # async device_put of chunks i+1..i+prefetch_depth under chunk i's
+    # device compute.  Host RSS is then bounded by the WINDOW and the
+    # trainable size by disk; spilled files double as a persistent
+    # warm-ETL artifact (same data + config ⇒ the chunk compile is
+    # skipped on the next run).  prefetch_depth=0 disables the thread
+    # (chunks load synchronously from the store).
+    spill_dir: str | None = None
+    host_max_resident: int = 2
+    prefetch_depth: int = 2
     # Warm-path artifact caches (photon_ml_tpu.cache): plan_cache_dir
     # persists compiled GRR plans keyed by dataset fingerprint ×
     # plan-config × planner version, so the second run of a workload
@@ -259,6 +274,14 @@ class TrainingConfig:
             raise ValueError("sparse_layout must be AUTO|GRR|COLMAJOR|ELL")
         if self.chunk_layout not in ("AUTO", "GRR", "ELL"):
             raise ValueError("chunk_layout must be AUTO|GRR|ELL")
+        if self.host_max_resident < 1:
+            raise ValueError("host_max_resident must be >= 1")
+        if self.prefetch_depth < 0:
+            raise ValueError("prefetch_depth must be >= 0")
+        if self.spill_dir is not None and self.chunk_rows is None:
+            raise ValueError(
+                "spill_dir requires chunked training (chunk_rows): "
+                "only chunk batches spill to the disk tier")
         if self.chunk_rows is not None:
             if self.chunk_rows <= 0:
                 raise ValueError("chunk_rows must be positive")

@@ -25,6 +25,25 @@ keeps up to ``max_resident`` chunks live in HBM so datasets that DO fit
 pay the transfer once (the resident and streaming regimes are one code
 path).
 
+Three residency tiers (round 8 completes the set):
+
+1. **HBM** — ``max_resident`` device chunks (``optim.streaming``).
+2. **Host RAM** — without ``spill_dir``, every chunk lives as numpy
+   leaves in ``chunks`` (bounded by host RAM: 26.4 GB at 3×10⁷
+   examples, the round-5 wall).
+3. **Disk** — with ``spill_dir`` (``$PHOTON_ML_TPU_SPILL_DIR`` is
+   honored by the config/estimator layer, not here),
+   chunks spill to atomic per-chunk ``.npz`` files
+   (``data.chunk_store``) and at most ``host_max_resident`` decoded
+   chunks stay live (memory-mapped, LRU) — host RSS is bounded by the
+   WINDOW, dataset size by disk, and ``optim.streaming``'s prefetch
+   thread overlaps disk read → host staging → async device_put of
+   chunks i+1..i+depth under chunk i's compute.  Offsets (GAME CD
+   residual state) stay OUT of the spilled payload — ``chunk(i)``
+   overlays the live window — so ``set_offsets`` is an O(n) host write
+   and spilled files double as persistent warm-ETL artifacts across
+   runs.
+
 Layouts per chunk (``layout=``):
 - ``"grr"`` — compiled GRR plans (``data.grr.build_sharded_grr_pairs``,
   chunks-as-shards): kernel-speed steps; ~1.6 GB/10⁶ examples streamed
@@ -53,12 +72,16 @@ logger = logging.getLogger(__name__)
 
 @dataclasses.dataclass
 class ChunkedBatch:
-    """K congruent host-resident chunk batches over one example axis.
+    """K congruent chunk batches over one example axis.
 
-    ``chunks[i]`` is a ``SparseBatch`` with HOST (numpy) leaves — or,
-    when ``mesh`` is set, a list of per-device host sub-batches to be
-    assembled example-sharded on use.  All chunks have identical pytree
-    structure and leaf shapes (one compile serves all).
+    Resident mode (``store`` is None): ``chunks[i]`` is a
+    ``SparseBatch`` with HOST (numpy) leaves — or, when ``mesh`` is
+    set, a list of per-device host sub-batches to be assembled
+    example-sharded on use.  Spilled mode (``store`` set): ``chunks``
+    holds placeholders and ``chunk(i)`` pulls from the disk-backed LRU
+    window, overlaying the current ``offsets_host`` slice.  All chunks
+    have identical pytree structure and leaf shapes (one compile
+    serves all) either way; consumers go through ``chunk(i)``.
     """
 
     chunks: list
@@ -67,6 +90,11 @@ class ChunkedBatch:
     chunk_rows: int        # examples per chunk (last chunk padded)
     layout: str
     mesh: object | None = None   # jax.sharding.Mesh | None
+    store: object | None = None  # data.chunk_store.ChunkStore | None
+    # Spilled mode: offsets over the FULL padded chunk grid
+    # [n_chunks·chunk_rows] — CD-iteration state kept out of the
+    # spilled payload so chunk files survive ``set_offsets``.
+    offsets_host: np.ndarray | None = None
 
     @property
     def n_chunks(self) -> int:
@@ -77,15 +105,36 @@ class ChunkedBatch:
         lo = i * self.chunk_rows
         return lo, min(lo + self.chunk_rows, self.n)
 
+    def chunk(self, i: int):
+        """Host pieces of chunk i, current offsets installed — the one
+        accessor every consumer uses (resident list or spill store)."""
+        if self.store is None:
+            return self.chunks[i]
+        c = self.store.get(i)
+        off = self.offsets_host[i * self.chunk_rows:
+                                (i + 1) * self.chunk_rows]
+        if self.mesh is None:
+            return c.replace(offsets=off)
+        per = self.chunk_rows // len(c)
+        return [b.replace(offsets=off[j * per:(j + 1) * per])
+                for j, b in enumerate(c)]
+
     def set_offsets(self, offsets: np.ndarray) -> None:
         """Install new per-example offsets (GAME coordinate-descent
-        residual passing) into the host chunks, zero-padded to the
-        chunk grid.  Callers holding device copies must invalidate
-        them (``optim.streaming.ChunkedGLMObjective.invalidate``)."""
+        residual passing), zero-padded to the chunk grid.  Resident
+        mode rewrites the host chunks; spilled mode only rewrites the
+        external offsets window (chunk files are offset-free).  Callers
+        holding device copies must invalidate them
+        (``optim.streaming.ChunkedGLMObjective.invalidate``)."""
         offsets = np.asarray(offsets, np.float32)
         if offsets.shape[0] != self.n:
             raise ValueError(
                 f"offsets length {offsets.shape[0]} != n {self.n}")
+        if self.store is not None:
+            self.offsets_host = np.zeros(
+                self.n_chunks * self.chunk_rows, np.float32)
+            self.offsets_host[: self.n] = offsets
+            return
         for i in range(self.n_chunks):
             lo, hi = self.chunk_slice(i)
             pad = np.zeros(self.chunk_rows, np.float32)
@@ -128,8 +177,10 @@ def build_chunked_batch(
     row_capacity: int | None = None,
     drop_ell_with_grr: bool = True,
     cache_dir: str | None = None,
+    spill_dir: str | None = None,
+    host_max_resident: int = 2,
 ) -> ChunkedBatch:
-    """Compile a dataset into K congruent host chunk batches.
+    """Compile a dataset into K congruent chunk batches.
 
     ``rows``: ``SparseRows`` (scale path) or list of (col_ids, values)
     pairs.  Exactly one of ``chunk_rows`` / ``n_chunks`` must be given.
@@ -144,6 +195,23 @@ def build_chunked_batch(
     ``cache_dir`` enables the on-disk plan cache for those chunk plans
     (``photon_ml_tpu.cache``): the scale run's plan compile is paid
     once per dataset, not once per run.
+
+    ``spill_dir`` (None = stay host-resident) activates the disk tier
+    (``data.chunk_store``).  Deliberately EXPLICIT at this layer — the
+    ``$PHOTON_ML_TPU_SPILL_DIR`` default is applied by the config/
+    estimator layer, so library callers building a resident baseline
+    (bench control arms, parity tests) cannot be silently flipped to
+    the spill store by ambient environment.  With the disk tier on:
+    chunks spill to atomic content-keyed ``.npz`` files and at most
+    ``host_max_resident`` decoded chunks stay live.  ELL chunks are
+    built AND spilled one at a time, so peak RSS during ETL is bounded
+    by the window too; GRR chunk plans need the global congruent build
+    first (shared hot/mid sets, pooled overflow, common padding), so
+    their ETL peak is one full plan set — they spill right after and
+    steady-state RSS is bounded either way.  A chunk file that already
+    exists for the same content key is NOT rebuilt (warm ETL); a
+    missing or corrupt file at sweep time rebuilds from ``rows``
+    (lineage), so the store can never fail a run.
     """
     from photon_ml_tpu.data.sparse_rows import SparseRows
 
@@ -187,37 +255,106 @@ def build_chunked_batch(
         return cols_p, vals_p, [pad1(labels), pad1(weights),
                                 pad1(offsets), mask]
 
-    pieces_arr = [piece_arrays(p) for p in range(n_pieces)]
+    def make_pieces(pieces_arr, grr_pairs, zero_offsets=False):
+        pieces = []
+        for (cols_p, vals_p, (lab, wt, off, mask)), pair in zip(
+                pieces_arr, grr_pairs):
+            if pair is not None and drop_ell_with_grr:
+                # The plan serves every contraction; the ELL copy would
+                # only add 8 bytes/nnz to every chunk transfer.
+                cols_p = np.zeros((per, 0), np.int32)
+                vals_p = np.zeros((per, 0), np.float32)
+            if zero_offsets:
+                off = np.zeros(per, np.float32)
+            pieces.append(_host_chunk(cols_p, vals_p, lab, wt, off,
+                                      mask, dim, grr=pair))
+        return pieces
 
-    grr_pairs = [None] * n_pieces
-    if layout == "grr":
-        from photon_ml_tpu.data.grr import build_sharded_grr_pairs
+    def group(pieces):
+        if mesh is None:
+            return pieces
+        return [pieces[i * n_dev:(i + 1) * n_dev]
+                for i in range(len(pieces) // n_dev)]
 
-        grr_pairs = build_sharded_grr_pairs(
-            [c for c, _, _ in pieces_arr],
-            [v for _, v, _ in pieces_arr],
-            dim,
-            cache_dir=cache_dir,
-        )
+    def compile_all(zero_offsets=False):
+        pieces_arr = [piece_arrays(p) for p in range(n_pieces)]
+        grr_pairs = [None] * n_pieces
+        if layout == "grr":
+            from photon_ml_tpu.data.grr import build_sharded_grr_pairs
 
-    pieces = []
-    for (cols_p, vals_p, (lab, wt, off, mask)), pair in zip(pieces_arr,
-                                                            grr_pairs):
-        if pair is not None and drop_ell_with_grr:
-            # The plan serves every contraction; the ELL copy would
-            # only add 8 bytes/nnz to every chunk transfer.
-            cols_p = np.zeros((per, 0), np.int32)
-            vals_p = np.zeros((per, 0), np.float32)
-        pieces.append(_host_chunk(cols_p, vals_p, lab, wt, off, mask,
-                                  dim, grr=pair))
+            grr_pairs = build_sharded_grr_pairs(
+                [c for c, _, _ in pieces_arr],
+                [v for _, v, _ in pieces_arr],
+                dim,
+                cache_dir=cache_dir,
+            )
+        return group(make_pieces(pieces_arr, grr_pairs, zero_offsets))
 
-    if mesh is None:
-        chunks = pieces
-    else:
-        chunks = [pieces[i * n_dev:(i + 1) * n_dev]
-                  for i in range(n_chunks)]
+    if spill_dir is None:
+        chunks = compile_all()
+        logger.info(
+            "chunked batch: n=%d -> %d chunks x %d rows (%s%s)", n,
+            n_chunks, chunk_rows, layout,
+            f", {n_dev}-device mesh" if mesh else "")
+        return ChunkedBatch(chunks=chunks, dim=dim, n=n,
+                            chunk_rows=chunk_rows, layout=layout,
+                            mesh=mesh)
+
+    # -- spilled build: disk tier on, host RSS bounded by the window --
+    from photon_ml_tpu.data.chunk_store import ChunkStore, store_key
+
+    key = store_key(rows, labels, weights, dim, chunk_rows=chunk_rows,
+                    layout=layout, n_dev=n_dev, row_capacity=k,
+                    drop_ell_with_grr=drop_ell_with_grr)
+
+    def build_chunk_ell(i):
+        """One ELL chunk, independently of the others (congruence is
+        by construction: shared k / per / padding grid)."""
+        ps = range(i * n_dev, (i + 1) * n_dev)
+        pieces = make_pieces([piece_arrays(p) for p in ps],
+                             [None] * n_dev, zero_offsets=True)
+        return pieces if mesh is not None else pieces[0]
+
+    def rebuild(i):
+        """Lineage fallback for a missing/corrupt chunk file."""
+        if layout == "ell":
+            return build_chunk_ell(i)
+        # GRR congruence (shared hot/mid sets, pooled overflow, common
+        # padding) is a GLOBAL property: rebuilding one chunk means
+        # rebuilding the plan set (the plan cache makes this one load
+        # when cache_dir is set).  Heal every missing sibling while the
+        # set is in hand.
+        chunks_all = compile_all(zero_offsets=True)
+        for j, ch in enumerate(chunks_all):
+            if j != i and not store.has(j):
+                store.put(j, ch, keep_resident=False)
+        return chunks_all[i]
+
+    store = ChunkStore(spill_dir, key, n_chunks,
+                       host_max_resident=host_max_resident,
+                       rebuild=rebuild)
+    missing = [i for i in range(n_chunks) if not store.has(i)]
+    if missing and layout == "ell":
+        # Build-time spill: one chunk in flight at a time — ETL peak
+        # RSS is (window + 1) chunks, not the dataset.
+        for i in missing:
+            store.put(i, build_chunk_ell(i))
+    elif missing:
+        chunks_all = compile_all(zero_offsets=True)
+        for i in missing:
+            store.put(i, chunks_all[i])
+    if missing:
+        from photon_ml_tpu.data.chunk_store import release_free_heap
+
+        release_free_heap()   # build churn must not read as steady RSS
+    offsets_host = np.zeros(n_chunks * chunk_rows, np.float32)
+    offsets_host[:n] = offsets
     logger.info(
-        "chunked batch: n=%d -> %d chunks x %d rows (%s%s)", n, n_chunks,
-        chunk_rows, layout, f", {n_dev}-device mesh" if mesh else "")
-    return ChunkedBatch(chunks=chunks, dim=dim, n=n,
-                        chunk_rows=chunk_rows, layout=layout, mesh=mesh)
+        "chunked batch: n=%d -> %d chunks x %d rows (%s%s), spilled to "
+        "%s (%d built, %d reused; host window %d)", n, n_chunks,
+        chunk_rows, layout, f", {n_dev}-device mesh" if mesh else "",
+        spill_dir, len(missing), n_chunks - len(missing),
+        store.host_max_resident)
+    return ChunkedBatch(chunks=[None] * n_chunks, dim=dim, n=n,
+                        chunk_rows=chunk_rows, layout=layout, mesh=mesh,
+                        store=store, offsets_host=offsets_host)

@@ -217,11 +217,21 @@ class GameEstimator:
 
                     layout = ("GRR" if jax.default_backend() == "tpu"
                               else "ELL")
+                from photon_ml_tpu.data.chunk_store import (
+                    resolve_spill_dir,
+                )
+
                 chunked = build_chunked_batch(
                     rows, dim, labels, weights=weights,
                     chunk_rows=cfg.chunk_rows, layout=layout.lower(),
                     mesh=mesh,
                     cache_dir=cfg.plan_cache_dir,
+                    # Env default ($PHOTON_ML_TPU_SPILL_DIR) applies at
+                    # THIS layer only; the library builder stays
+                    # explicit so resident baselines can't be flipped
+                    # by ambient environment.
+                    spill_dir=resolve_spill_dir(cfg.spill_dir),
+                    host_max_resident=cfg.host_max_resident,
                 )
                 return {
                     "chunked": chunked, "batch": None,
@@ -458,6 +468,7 @@ class GameEstimator:
                         optimizer=coord_cfg.optimizer.optimizer,
                         config=ocfg,
                         max_resident=cfg.chunk_max_resident,
+                        prefetch_depth=cfg.prefetch_depth,
                     )
                     continue
                 distributed = None
@@ -642,7 +653,8 @@ class GameEstimator:
             return ChunkedFixedEffectCoordinate(
                 name=coord.name, chunked=coord.chunked, objective=obj_l,
                 optimizer=coord.optimizer, config=coord.config,
-                max_resident=coord.max_resident)
+                max_resident=coord.max_resident,
+                prefetch_depth=coord.prefetch_depth)
         base = coord.problem.objective
         obj_l = base.replace(reg=base.reg.replace(
             l1_weight=reg1.l1_weights[0], l2_weight=reg1.l2_weights[0]))

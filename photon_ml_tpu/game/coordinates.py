@@ -418,8 +418,12 @@ class ChunkedFixedEffectCoordinate(Coordinate):
     Same ``train``/``score`` contract as ``FixedEffectCoordinate``; the
     solve is the host-driven ``optim.streaming.streaming_lbfgs_solve``
     over a ``ChunkedGLMObjective`` (per-chunk device programs, exact
-    chunk-accumulated objective).  Down-sampling views and TRON are not
-    supported on this path (documented config error)."""
+    chunk-accumulated objective).  When the chunked batch is
+    disk-spilled (``spill_dir`` — the out-of-core tier), every training
+    AND ``_per_example`` scoring sweep runs the async disk→host→device
+    prefetch pipeline, ``prefetch_depth`` chunks ahead.  Down-sampling
+    views and TRON are not supported on this path (documented config
+    error)."""
 
     name: str
     chunked: "object"                 # data.chunked_batch.ChunkedBatch
@@ -427,6 +431,7 @@ class ChunkedFixedEffectCoordinate(Coordinate):
     optimizer: "object"               # OptimizerType
     config: OptimizerConfig
     max_resident: int = 1
+    prefetch_depth: int = 2
 
     def __post_init__(self):
         from photon_ml_tpu.optim.base import OptimizerType
@@ -437,7 +442,8 @@ class ChunkedFixedEffectCoordinate(Coordinate):
                 "chunked training supports LBFGS/OWL-QN only (TRON's "
                 "inner CG would stream the dataset once per CG step)")
         self._obj = ChunkedGLMObjective(
-            self.objective, self.chunked, max_resident=self.max_resident)
+            self.objective, self.chunked, max_resident=self.max_resident,
+            prefetch_depth=self.prefetch_depth)
 
     @property
     def problem(self) -> OptimizationProblem:

@@ -34,6 +34,8 @@ to ~1 (see ``ops.objective`` swept surface).
 from __future__ import annotations
 
 import logging
+import queue
+import threading
 from functools import partial
 
 import jax
@@ -86,6 +88,95 @@ def _place_chunk(chunk, mesh):
             gshape, sharding, placed)
 
     return jax.tree.map(asm, *chunk)
+
+
+class _ChunkPrefetcher:
+    """Background disk → host → device pipeline stage.
+
+    One thread walks the sweep's chunk order ahead of the consumer:
+    ``batch.chunk(i)`` pulls the host pieces (the chunk store's disk
+    read / LRU window), ``_place_chunk`` starts the ASYNC host→device
+    transfer, and the (host, device) pair lands in a bounded queue of
+    depth ``depth`` — so chunk i's device compute overlaps chunk
+    i+1..i+depth's disk reads AND transfers, the third pipeline level
+    in front of the classic device double-buffer.  The host reference
+    rides in the queue item until the consumer takes it, so the LRU
+    window can never free arrays out from under an in-flight copy.
+
+    Determinism: the queue preserves the thread's (sweep) order and
+    ``next(expect)`` asserts it — the chunk visit order the parity and
+    ``sweeps``-odometer contracts rely on cannot be reordered by the
+    pipeline.  The thread registers as a store reader so
+    ``ChunkStore.assert_quiesced`` can prove no use-after-evict.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, batch, mesh, depth: int):
+        self._batch = batch
+        self._mesh = mesh
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self, order) -> None:
+        self._batch.store.begin_read()
+        self._thread = threading.Thread(
+            target=self._run, args=(list(order),), daemon=True,
+            name="photon-chunk-prefetch")
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self, order) -> None:
+        try:
+            for i in order:
+                if self._stop.is_set():
+                    return
+                host = self._batch.chunk(i)          # disk -> host
+                buf = _place_chunk(host, self._mesh)  # host -> device
+                if not self._put((i, host, buf)):
+                    return
+        except BaseException as e:   # surfaced at the consumer's next()
+            self._error = e
+            self._put((self._SENTINEL, None, None))
+        finally:
+            self._batch.store.end_read()
+
+    def next(self, expect: int):
+        """The next placed chunk; raises the producer's error, and
+        asserts the deterministic order."""
+        i, host, buf = self._q.get()
+        if i is self._SENTINEL:
+            raise self._error
+        if i != expect:
+            raise AssertionError(
+                f"prefetch order violated: got chunk {i}, "
+                f"expected {expect}")
+        del host   # consumer now owns the device buffer
+        return buf
+
+    def close(self) -> None:
+        """Quiesce: stop the producer, drain, join.  Idempotent."""
+        t = self._thread
+        if t is None:
+            return
+        self._stop.set()
+        while t.is_alive():
+            try:
+                self._q.get_nowait()   # unblock a full-queue producer
+            except queue.Empty:
+                t.join(timeout=0.05)
+        t.join()
+        self._thread = None
 
 
 # ---------------------------------------------------------------------------
@@ -194,18 +285,27 @@ class ChunkedGLMObjective:
     once — the resident and streaming regimes are one code path);
     beyond it, chunks are re-placed each pass, double-buffered.
 
+    When the batch carries a spill store (``data.chunk_store`` — the
+    disk tier), each sweep runs a background ``_ChunkPrefetcher``
+    instead: disk read → host staging → async device_put of chunks
+    i+1..i+``prefetch_depth`` overlap chunk i's device compute, and the
+    chunk visit order (hence float-summation order and the ``sweeps``
+    odometer) is exactly the resident path's.
+
     ``sweeps`` counts full chunk sweeps since construction — the
     data-pass odometer the bench's ``sweep`` section reads to show the
     L → 1 passes-per-iteration amortization.
     """
 
     def __init__(self, objective: GLMObjective, batch: ChunkedBatch,
-                 max_resident: int = 1):
+                 max_resident: int = 1, prefetch_depth: int = 2):
         self.objective = objective
         self.batch = batch
         self.max_resident = max_resident
+        self.prefetch_depth = prefetch_depth
         self.sweeps = 0
         self._cache: dict = {}
+        self._active_prefetcher: _ChunkPrefetcher | None = None
         inner = objective.replace(
             reg=RegularizationContext.none(), prior=None)
         self._mesh = batch.mesh
@@ -226,27 +326,75 @@ class ChunkedGLMObjective:
     # -- chunk residency ---------------------------------------------------
 
     def invalidate(self) -> None:
-        """Drop device copies (after ``ChunkedBatch.set_offsets``)."""
+        """Drop device copies (after ``ChunkedBatch.set_offsets``).
+
+        The prefetch pipeline is quiesced FIRST, and the store must
+        prove it (``assert_quiesced``): freeing buffers while the
+        background thread is mid device_put on an LRU-windowed chunk
+        would be a use-after-evict race."""
+        pf = self._active_prefetcher
+        if pf is not None:
+            pf.close()
+            self._active_prefetcher = None
+        if self.batch.store is not None:
+            self.batch.store.assert_quiesced()
         self._cache.clear()
 
     def _get(self, i: int):
         if i in self._cache:
             return self._cache[i]
-        b = _place_chunk(self.batch.chunks[i], self._mesh)
+        b = _place_chunk(self.batch.chunk(i), self._mesh)
         if len(self._cache) < self.max_resident:
             self._cache[i] = b
         return b
 
-    def _sweep(self, per_chunk, combine):
-        """Stream all chunks through ``per_chunk``, double-buffered."""
+    def _chunk_stream(self):
+        """Device chunks in deterministic order 0..K-1, pipelined.
+
+        Spill-store batches run the three-tier prefetch thread (disk →
+        host window → async device_put, ``prefetch_depth`` deep);
+        resident batches keep the classic device double-buffer (the
+        transfer of chunk i+1 dispatches before chunk i's compute)."""
         k = self.batch.n_chunks
-        self.sweeps += 1
-        acc = None
+        if k == 0:
+            return
+        if self.batch.store is not None and self.prefetch_depth > 0:
+            pf = _ChunkPrefetcher(self.batch, self._mesh,
+                                  self.prefetch_depth)
+            self._active_prefetcher = pf
+            pf.start(range(k))
+            try:
+                for i in range(k):
+                    yield pf.next(i)
+            finally:
+                pf.close()
+                self._active_prefetcher = None
+            return
         nxt = self._get(0)
         for i in range(k):
             cur = nxt
             if i + 1 < k:
                 nxt = self._get(i + 1)   # async transfer under compute
+            yield cur
+
+    def _sweep(self, per_chunk, combine):
+        """Stream all chunks through ``per_chunk``, pipelined.
+
+        Out-of-core batches add BACKPRESSURE: chunk i-1's accumulate is
+        fenced before chunk i dispatches, so the async dispatch queue
+        holds one chunk's buffers + temporaries instead of all K —
+        without it a K-chunk pass keeps every placed chunk live until
+        its compute retires, un-bounding exactly the memory the store
+        exists to bound.  On a device backend the chunk programs
+        serialize on the accelerator anyway (the prefetch thread keeps
+        transfers ahead regardless), so the fence costs a dispatch
+        bubble, not overlap."""
+        self.sweeps += 1
+        bounded = self.batch.store is not None
+        acc = None
+        for cur in self._chunk_stream():
+            if bounded and acc is not None:
+                jax.block_until_ready(acc)
             out = per_chunk(cur)
             acc = out if acc is None else combine(acc, out)
         return acc
@@ -351,14 +499,20 @@ class ChunkedGLMObjective:
         overlap the next chunk's compute; the blocking ``np.asarray``
         conversions happen once at the end, when most bytes have
         already landed (a serial per-chunk ``np.asarray`` would fence
-        every chunk)."""
+        every chunk).  The chunk feed is the same pipelined
+        ``_chunk_stream`` the objective sweeps use — spill-store
+        batches prefetch disk→host→device here too (scoring sweeps are
+        a full data pass like any other)."""
         pending = []
-        k = self.batch.n_chunks
-        nxt = self._get(0)
-        for i in range(k):
-            cur = nxt
-            if i + 1 < k:
-                nxt = self._get(i + 1)
+        bounded = self.batch.store is not None
+        for i, cur in enumerate(self._chunk_stream()):
+            if bounded and pending:
+                # Backpressure (see _sweep): chunk i-1's compute must
+                # retire before chunk i dispatches, or every placed
+                # chunk stays live in the dispatch queue.  Only the
+                # [rows]-sized margins are fenced — their async D2H
+                # copies keep overlapping later chunks' compute.
+                jax.block_until_ready(pending[-1][0])
             m = fn(cur)
             try:
                 m.copy_to_host_async()

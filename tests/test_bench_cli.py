@@ -87,6 +87,35 @@ def test_bench_sweep_section_contract(tmp_path):
     assert sweep["pass_amortization"] >= 2.0
 
 
+def test_bench_stream_section_contract(tmp_path):
+    """`--section stream` keeps the budget/JSON-last-line contract and
+    records the out-of-core measurement: per-arm wall-clock and peak
+    host RSS (each arm in its own subprocess), the LRU window bound,
+    gradient parity across arms, and the per-section peak_rss_mb
+    trajectory satellite."""
+    proc = _run_bench(tmp_path, "--section", "stream",
+                      "--budget-s", "240", *_TINY)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert rec["section"] == "stream"
+    assert rec.get("errors") is None
+    s = rec["stream"]
+    assert s["host_max_resident"] == 2
+    # Chunks must dwarf the window (the RSS-bound claim's precondition)
+    assert s["n_chunks"] >= 6 * s["host_max_resident"]
+    # LRU bound held during the spilled arm's sweeps.
+    assert 1 <= s["spilled"]["peak_live_chunks"] <= 2
+    assert s["spilled"]["disk_loads"] > 0
+    for arm in ("spilled", "resident"):
+        assert s[arm]["pass_ms"] > 0
+        assert s[arm]["peak_rss_mb"] > 0
+    assert s["grad_parity_max"] < 1e-3
+    assert s["pass_time_ratio"] is not None
+    # Satellite: every section records the RSS high-water trajectory.
+    assert rec["peak_rss_mb"]["stream"] > 0
+
+
 def test_bench_zero_budget_still_emits_json(tmp_path):
     """A hopeless budget skips every section but the process still
     exits 0 with one parseable JSON line recording the skips."""

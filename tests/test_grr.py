@@ -644,3 +644,35 @@ def test_idx_range_native_matches_numpy(rng):
               (np.where(keep, vals, 0).astype(np.float64)
                * w[np.minimum(cols, dim - 1)]).reshape(-1))
     np.testing.assert_allclose(out_n, direct, rtol=2e-3, atol=2e-3)
+
+
+def test_spill_warning_rate_limited(caplog):
+    """Satellite (round 8): inside a plan build the per-direction "GRR
+    spill fraction" warning aggregates into ONE max/mean summary
+    (MULTICHIP_r05's tail drowned the dryrun in ~20 identical lines);
+    outside any build scope the immediate warning is preserved."""
+    import logging
+
+    from photon_ml_tpu.data.grr import _spill_warnings
+
+    with caplog.at_level(logging.WARNING, logger="photon_ml_tpu.data.grr"):
+        caplog.clear()
+        with _spill_warnings:
+            for _ in range(20):
+                _spill_warnings.note(20, 100)   # 20% on the XLA path
+            _spill_warnings.note(1, 100)        # under threshold
+            assert not caplog.records           # silent while collecting
+        assert len(caplog.records) == 1
+        msg = caplog.records[0].getMessage()
+        assert "20 of 21 direction builds" in msg
+        assert "max 20.0%" in msg and "mean 20.0%" in msg
+
+        caplog.clear()
+        with _spill_warnings:                   # clean builds: no line
+            _spill_warnings.note(0, 100)
+        assert not caplog.records
+
+        caplog.clear()
+        _spill_warnings.note(20, 100)           # outside a build scope
+        assert len(caplog.records) == 1
+        assert "20.0% (20 of 100)" in caplog.records[0].getMessage()
