@@ -71,7 +71,7 @@ V5E_PEAK_GBPS = 819.0
 
 DEFAULT_SECTIONS = ("etl", "cached", "grr", "segment_sum", "colmajor")
 ALL_SECTIONS = DEFAULT_SECTIONS + ("powerlaw", "chunked", "sweep",
-                                   "stream")
+                                   "stream", "score")
 DEFAULT_BUDGET_S = 840.0
 DEFAULT_N, DEFAULT_D, DEFAULT_K = 1_000_000, 100_000, 30
 
@@ -84,6 +84,15 @@ STREAM_CHUNKS = 24
 STREAM_WINDOW = 2
 STREAM_DEPTH = 2
 STREAM_SWEEPS = 5
+
+# Scoring section shape (ISSUE 4): same window-vs-dataset discipline as
+# the stream section — the streamed arm's score chunks must dwarf the
+# LRU host window for the bounded-RSS claim to mean anything.
+SCORE_CHUNKS = 16
+SCORE_WINDOW = 2
+SCORE_DEPTH = 2
+SCORE_PASSES = 3
+SCORE_D_RE = 4
 
 # λ-sweep section shape: lanes × solver-iteration cap (kept static so
 # the batched and sequential arms solve the identical problem set).
@@ -110,6 +119,9 @@ SECTION_EST_S = {
     # Two chunk ETLs (one spilling to disk) + 2×(1 warm + STREAM_SWEEPS
     # timed) full-data passes.
     "stream": 420.0,
+    # Two subprocess arms × (score-chunk ETL + 1 warm + SCORE_PASSES
+    # timed one-pass scores).
+    "score": 300.0,
 }
 
 
@@ -252,7 +264,7 @@ class BenchContext:
 
     def estimate(self, section: str) -> float:
         est = SECTION_EST_S[section] * self.scale
-        if section == "stream":
+        if section in ("stream", "score"):
             # Two subprocess arms pay a fixed jax-import + compile cost
             # each, regardless of shape.
             est += 60.0
@@ -911,6 +923,207 @@ def section_stream(ctx: BenchContext) -> None:
           f"{s['rss_delta_ratio']}x", file=sys.stderr)
 
 
+def _make_score_workload(n: int, d: int, k: int):
+    """Synthetic GAME scoring workload: sparse fixed-effect shard +
+    one dense non-projected random effect — the coordinate mix the
+    fused chunk program must cover — with a model of matching shape."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+    from photon_ml_tpu.game.dataset import GameDataset, group_by_entity
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.models.glm import TaskType
+
+    cols, vals, labels = _make_ell(n, d, k)
+    rows = SparseRows.from_flat(
+        np.arange(n + 1, dtype=np.int64) * k,
+        cols.reshape(-1).astype(np.int64), vals.reshape(-1))
+    rng = np.random.default_rng(5)
+    E = max(32, n // 100)
+    ids = rng.integers(0, E, n)
+    x_re = rng.normal(0, 1, (n, SCORE_D_RE)).astype(np.float32)
+    grouping = group_by_entity(ids)
+    blocks = [jnp.asarray(rng.normal(0, 0.1, (ne, SCORE_D_RE))
+                          .astype(np.float32))
+              for ne in grouping.n_entities]
+    model = GameModel(models={
+        "global": FixedEffectModel(
+            coefficients=Coefficients(means=jnp.asarray(
+                rng.normal(0, 0.1, d).astype(np.float32))),
+            feature_shard="global"),
+        "per_user": RandomEffectModel(
+            coefficient_blocks=blocks, grouping=grouping,
+            feature_shard="re", entity_key="userId"),
+    })
+    dataset = GameDataset(labels=labels,
+                          features={"global": rows, "re": x_re},
+                          entity_ids={"userId": ids})
+    return model, TaskType.LOGISTIC_REGRESSION, dataset
+
+
+def score_arm_main(args) -> int:
+    """One arm of the ``score`` section in its OWN process (same
+    rationale as ``stream_arm_main``: per-arm ``ru_maxrss`` is the
+    honest high-water mark).  ``streamed`` runs the fused one-pass
+    chunk pipeline with the disk tier; ``resident`` the per-coordinate
+    ``GameTransformer.transform``.  Emits one JSON line and saves the
+    margins for the parent's cross-arm parity check."""
+    from photon_ml_tpu.estimators.game_transformer import GameTransformer
+
+    arm = args.score_arm
+    n, d, k = args.n, args.d, args.k
+    model, task, dataset = _make_score_workload(n, d, k)
+    transformer = GameTransformer(model=model, task=task)
+    chunk_rows = -(-n // SCORE_CHUNKS)
+    base_mb = _current_rss_mb()
+    base_anon_mb = _current_rss_mb("RssAnon")
+
+    scorer = None
+    if arm == "streamed":
+        from photon_ml_tpu.estimators.streaming_scorer import (
+            StreamingGameScorer,
+        )
+
+        # ONE scorer across passes: the plan (device tables + the spill
+        # store's content key) is per-dataset state, derived once — a
+        # production scoring run pays it once per run.
+        scorer = StreamingGameScorer(
+            model=model, task=task, chunk_rows=chunk_rows,
+            spill_dir=os.path.join(args.cache_dir, "spill_score"),
+            host_max_resident=SCORE_WINDOW,
+            prefetch_depth=SCORE_DEPTH)
+
+    last_result = {}
+
+    def one_pass():
+        if arm == "streamed":
+            last_result.clear()
+            last_result.update(scorer.score(dataset, keep_margins=True))
+            return last_result["margins"]
+        return transformer.transform(dataset)
+
+    t0 = time.time()
+    margins = one_pass()             # warm: compile + (streamed) spill
+    etl_s = time.time() - t0
+    times = []
+    with _RssSampler() as rss:
+        for _ in range(SCORE_PASSES):
+            t0 = time.time()
+            margins = one_pass()
+            times.append(time.time() - t0)
+    pass_s = float(np.median(times))
+    np.save(os.path.join(args.cache_dir, f"score_margins_{arm}.npy"),
+            np.asarray(margins))
+
+    peak = _peak_rss_mb()
+    anon = _current_rss_mb("RssAnon")
+    rec = {
+        "arm": arm,
+        "warm_s": round(etl_s, 1),
+        "pass_ms": round(pass_s * 1e3, 1),
+        "pass_ms_all": [round(t * 1e3, 1) for t in times],
+        "rows_per_sec": round(n / pass_s, 1),
+        "peak_rss_mb": round(peak, 1),
+        "sweep_peak_rss_mb": round(rss.peak_mb, 1),
+        "rss_delta_mb": (round(rss.peak_mb - base_mb, 1)
+                         if base_mb is not None else None),
+        "anon_delta_mb": (round(anon - base_anon_mb, 1)
+                          if anon is not None
+                          and base_anon_mb is not None else None),
+    }
+    if arm == "streamed":
+        # The ACTUAL chunk count from the scorer (ceil rounding can
+        # land below the SCORE_CHUNKS target at tiny n) — the
+        # window-vs-chunks evidence must not overstate itself.
+        rec.update({"n_chunks": last_result.get("n_chunks"),
+                    "chunk_rows": chunk_rows,
+                    "host_max_resident": SCORE_WINDOW,
+                    "prefetch_depth": SCORE_DEPTH,
+                    # Window-bound evidence: live decoded chunks during
+                    # the last timed pass never exceeded the LRU window.
+                    "peak_live_chunks": last_result.get(
+                        "store", {}).get("peak_resident")})
+    print(json.dumps(rec))
+    return 0
+
+
+def section_score(ctx: BenchContext) -> None:
+    """Streaming fused scoring vs per-coordinate resident scoring
+    (ISSUE 4 tentpole measurement): the SAME model × dataset scored by
+    both paths, each arm in its own subprocess (honest per-arm peak
+    RSS).  Claims under test: margins identical to float tolerance,
+    streamed peak RSS bounded by the chunk window (chunks total
+    SCORE_CHUNKS/SCORE_WINDOW = 8× the window), pass time within ~1.1×
+    of resident."""
+    import shutil
+    import subprocess
+
+    shutil.rmtree(os.path.join(ctx.cache_dir, "spill_score"),
+                  ignore_errors=True)   # honest cold spill ETL
+
+    def run_arm(arm: str) -> dict:
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--score-arm", arm, "--n", str(ctx.n), "--d", str(ctx.d),
+             "--k", str(ctx.k), "--cache-dir", ctx.cache_dir]
+            + (["--no-compile-cache"] if ctx.no_compile_cache else []),
+            capture_output=True, text=True,
+            timeout=max(60.0, ctx.remaining()),
+        )
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            raise RuntimeError(f"score arm {arm!r} failed "
+                               f"(rc={proc.returncode}): "
+                               f"{proc.stderr[-500:]}")
+        rec = json.loads(
+            [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+        rec["arm_wall_s"] = round(time.time() - t0, 1)
+        return rec
+
+    streamed = run_arm("streamed")
+    resident = run_arm("resident")
+    m_s = np.load(os.path.join(ctx.cache_dir,
+                               "score_margins_streamed.npy"))
+    m_r = np.load(os.path.join(ctx.cache_dir,
+                               "score_margins_resident.npy"))
+    parity = float(np.max(np.abs(m_s - m_r))) if len(m_s) else 0.0
+
+    def ratio(a, b):
+        if a is None or b is None or b == 0:
+            return None
+        return round(a / b, 2)
+
+    ctx.record["score"] = {
+        "n_chunks": streamed.get("n_chunks", SCORE_CHUNKS),
+        "host_max_resident": SCORE_WINDOW,
+        "prefetch_depth": SCORE_DEPTH,
+        "passes_timed": SCORE_PASSES,
+        "streamed": streamed,
+        "resident": resident,
+        "margin_parity_max": parity,
+        "pass_time_ratio": ratio(streamed["pass_ms"],
+                                 resident["pass_ms"]),
+        "peak_rss_ratio": ratio(resident["peak_rss_mb"],
+                                streamed["peak_rss_mb"]),
+        "rss_delta_ratio": ratio(resident["rss_delta_mb"],
+                                 streamed["rss_delta_mb"]),
+    }
+    s = ctx.record["score"]
+    print(f"score: streamed {streamed['pass_ms']} ms/pass "
+          f"({streamed['rows_per_sec']} rows/s, peak RSS "
+          f"{streamed['peak_rss_mb']} MB) vs resident "
+          f"{resident['pass_ms']} ms/pass ({resident['rows_per_sec']} "
+          f"rows/s, peak {resident['peak_rss_mb']} MB); time ratio "
+          f"{s['pass_time_ratio']}x, parity {parity:.2e}",
+          file=sys.stderr)
+
+
 SECTION_FNS = {
     "etl": section_etl,
     "cached": section_cached,
@@ -921,6 +1134,7 @@ SECTION_FNS = {
     "chunked": section_chunked,
     "sweep": section_sweep,
     "stream": section_stream,
+    "score": section_score,
 }
 
 
@@ -989,6 +1203,10 @@ def main(argv: list[str] | None = None) -> int:
                    default=None,
                    help="internal: run ONE arm of the stream section "
                         "in this process (per-arm peak-RSS isolation)")
+    p.add_argument("--score-arm", choices=("streamed", "resident"),
+                   default=None,
+                   help="internal: run ONE arm of the score section "
+                        "in this process (per-arm peak-RSS isolation)")
     args = p.parse_args(argv)
     if args.cache_dir is None:
         # Per-user default: a fixed shared-/tmp path would let another
@@ -1011,6 +1229,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.stream_arm:
         return stream_arm_main(args)
+    if args.score_arm:
+        return score_arm_main(args)
 
     import jax
 

@@ -346,6 +346,34 @@ class ScoringConfig:
     # JAX persistent compilation cache (see TrainingConfig): the 1037 s
     # scoring-program compile (PERF.md) is paid once per program shape.
     compilation_cache_dir: str | None = None
+    # Streaming fused scoring (estimators.streaming_scorer, ISSUE 4):
+    # score_chunk_rows activates the one-pass chunked pipeline — every
+    # coordinate scored by ONE fused device program per fixed-shape
+    # chunk, output sinks and evaluators fed chunk-wise (streaming
+    # accumulators), so peak memory is bounded by the chunk window, not
+    # the dataset.  None keeps the per-coordinate resident transform.
+    # spill_dir (default $PHOTON_ML_TPU_SPILL_DIR, same env as
+    # training) spills prepared score chunks to content-keyed .npz
+    # files (memory-mapped back, LRU host_max_resident window; spilled
+    # chunks double as a warm-scoring artifact across runs);
+    # prefetch_depth runs the background disk→host→device prefetch
+    # thread (0 = synchronous).
+    score_chunk_rows: int | None = None
+    spill_dir: str | None = None
+    host_max_resident: int = 2
+    prefetch_depth: int = 2
+
+    def validate(self) -> None:
+        if self.score_chunk_rows is not None and self.score_chunk_rows <= 0:
+            raise ValueError("score_chunk_rows must be positive")
+        if self.host_max_resident < 1:
+            raise ValueError("host_max_resident must be >= 1")
+        if self.prefetch_depth < 0:
+            raise ValueError("prefetch_depth must be >= 0")
+        if self.spill_dir is not None and self.score_chunk_rows is None:
+            raise ValueError(
+                "spill_dir requires streamed scoring (score_chunk_rows):"
+                " only score chunks spill to the disk tier")
 
 
 # ---------------------------------------------------------------------------
@@ -428,7 +456,9 @@ def training_config_from_json(text: str) -> TrainingConfig:
 
 
 def scoring_config_from_json(text: str) -> ScoringConfig:
-    return _build(ScoringConfig, json.loads(text))
+    cfg = _build(ScoringConfig, json.loads(text))
+    cfg.validate()
+    return cfg
 
 
 def load_training_config(path: str) -> TrainingConfig:

@@ -151,6 +151,28 @@ def encode_chunk(chunk) -> tuple[dict, dict]:
     return meta, arrays
 
 
+def encode_array_chunk(chunk: dict) -> tuple[dict, dict]:
+    """Generic flat array-dict chunk → (manifest, arrays): the scoring
+    pipeline's chunk payloads (ISSUE 4) are plain name → ndarray maps,
+    not SparseBatch pieces — same spill/mmap/LRU machinery, simpler
+    codec."""
+    arrays = {k: np.asarray(v) for k, v in chunk.items()}
+    meta = {"version": CHUNK_FORMAT_VERSION, "kind": "arrays",
+            "keys": sorted(arrays)}
+    return meta, arrays
+
+
+def decode_array_chunk(meta: dict, arrays) -> dict:
+    """Inverse of ``encode_array_chunk``; memmap views pass through
+    (score chunks stay file-backed in the host window)."""
+    if meta.get("version") != CHUNK_FORMAT_VERSION:
+        raise ValueError(f"chunk format {meta.get('version')!r} != "
+                         f"{CHUNK_FORMAT_VERSION}")
+    if meta.get("kind") != "arrays":
+        raise ValueError(f"chunk kind {meta.get('kind')!r} != 'arrays'")
+    return {k: arrays[k] for k in meta["keys"]}
+
+
 def decode_chunk(meta: dict, arrays):
     """Inverse of ``encode_chunk``; ``arrays`` may be lazy (memmap
     views or an open NpzFile).  Offsets come back ZERO — the caller
@@ -259,12 +281,16 @@ class ChunkStore:
     """
 
     def __init__(self, spill_dir: str, key: str, n_chunks: int,
-                 host_max_resident: int = 2, rebuild=None):
+                 host_max_resident: int = 2, rebuild=None, codec=None):
         self.dir = os.path.join(spill_dir, "chunks")
         self.key = key
         self.n_chunks = n_chunks
         self.host_max_resident = max(1, int(host_max_resident))
         self._rebuild = rebuild
+        # (encode, decode) pair; default is the SparseBatch chunk codec
+        # (training), ``(encode_array_chunk, decode_array_chunk)`` for
+        # the scoring pipeline's flat array-dict chunks.
+        self._encode, self._decode = codec or (encode_chunk, decode_chunk)
         self._resident: OrderedDict = OrderedDict()
         self._lock = threading.RLock()
         self._readers = 0
@@ -299,11 +325,15 @@ class ChunkStore:
         with self._lock:
             chunks = list(self._resident.values())
         for ch in chunks:
-            for b in (ch if isinstance(ch, list) else [ch]):
-                for f in _LEAF_FIELDS:
-                    a = getattr(b, f)
-                    if not isinstance(a, np.memmap):
-                        total += np.asarray(a).nbytes
+            if isinstance(ch, dict):            # array-dict chunks
+                leaves = list(ch.values())
+            else:
+                leaves = [getattr(b, f)
+                          for b in (ch if isinstance(ch, list) else [ch])
+                          for f in _LEAF_FIELDS]
+            for a in leaves:
+                if not isinstance(a, np.memmap):
+                    total += np.asarray(a).nbytes
         return total
 
     def _admit(self, i: int, chunk) -> None:
@@ -354,7 +384,7 @@ class ChunkStore:
         order will want first."""
         from photon_ml_tpu.cache.plan_cache import atomic_savez
 
-        meta, arrays = encode_chunk(chunk)
+        meta, arrays = self._encode(chunk)
         atomic_savez(self.path(i), meta, arrays)
         self.spills += 1
         if keep_resident is None:
@@ -389,7 +419,7 @@ class ChunkStore:
                 arrays = dict(np.load(path, allow_pickle=False))
             meta = json.loads(bytes(np.asarray(arrays["__meta__"]))
                               .decode())
-            return decode_chunk(meta, arrays)
+            return self._decode(meta, arrays)
         except Exception as e:
             if self._rebuild is None:
                 raise

@@ -213,6 +213,7 @@ def build_chunked_batch(
     missing or corrupt file at sweep time rebuilds from ``rows``
     (lineage), so the store can never fail a run.
     """
+    from photon_ml_tpu.data.grr import collect_spill_warnings
     from photon_ml_tpu.data.sparse_rows import SparseRows
 
     if not isinstance(rows, SparseRows):
@@ -291,7 +292,11 @@ def build_chunked_batch(
         return group(make_pieces(pieces_arr, grr_pairs, zero_offsets))
 
     if spill_dir is None:
-        chunks = compile_all()
+        # One aggregation scope around the whole sharded build: every
+        # per-shard sub-plan's spill note folds into ONE summary line
+        # (ISSUE 4 satellite — MULTICHIP_r05's tail was 15+ lines).
+        with collect_spill_warnings():
+            chunks = compile_all()
         logger.info(
             "chunked batch: n=%d -> %d chunks x %d rows (%s%s)", n,
             n_chunks, chunk_rows, layout,
@@ -334,15 +339,16 @@ def build_chunked_batch(
                        host_max_resident=host_max_resident,
                        rebuild=rebuild)
     missing = [i for i in range(n_chunks) if not store.has(i)]
-    if missing and layout == "ell":
-        # Build-time spill: one chunk in flight at a time — ETL peak
-        # RSS is (window + 1) chunks, not the dataset.
-        for i in missing:
-            store.put(i, build_chunk_ell(i))
-    elif missing:
-        chunks_all = compile_all(zero_offsets=True)
-        for i in missing:
-            store.put(i, chunks_all[i])
+    with collect_spill_warnings():   # one summary per sharded build
+        if missing and layout == "ell":
+            # Build-time spill: one chunk in flight at a time — ETL
+            # peak RSS is (window + 1) chunks, not the dataset.
+            for i in missing:
+                store.put(i, build_chunk_ell(i))
+        elif missing:
+            chunks_all = compile_all(zero_offsets=True)
+            for i in missing:
+                store.put(i, chunks_all[i])
     if missing:
         from photon_ml_tpu.data.chunk_store import release_free_heap
 

@@ -151,6 +151,18 @@ def _collect_spill_warnings(fn):
     return wrapped
 
 
+def collect_spill_warnings():
+    """Public aggregation scope for MULTI-build operations (ISSUE 4
+    satellite): a sharded/chunked build that compiles several plan
+    families — ``build_chunked_batch``'s per-chunk builds and rebuild
+    healing, ``shard_sparse_batch``'s per-shard set — enters this once
+    and every nested ``build_grr_pair``/``build_sharded_grr_pairs``
+    scope folds into ONE summary at the outermost exit (the scope is
+    re-entrant), instead of one line per sub-plan (the MULTICHIP_r05
+    tail printed 15+)."""
+    return _spill_warnings
+
+
 def _next_pow2(x: int) -> int:
     return 1 << max(0, int(x - 1).bit_length())
 

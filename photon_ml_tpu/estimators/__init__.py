@@ -4,5 +4,7 @@
 
 from photon_ml_tpu.estimators.game_estimator import FitResult, GameEstimator
 from photon_ml_tpu.estimators.game_transformer import GameTransformer
+from photon_ml_tpu.estimators.streaming_scorer import StreamingGameScorer
 
-__all__ = ["FitResult", "GameEstimator", "GameTransformer"]
+__all__ = ["FitResult", "GameEstimator", "GameTransformer",
+           "StreamingGameScorer"]

@@ -15,6 +15,13 @@ per-entity coefficient RDD — and sums ``CoordinateDataScores``.  Here:
 
 The summed scores are raw margins (``ModelDataScores``); callers apply
 the task's mean function for probability-space outputs.
+
+``transform`` walks the dataset once PER COORDINATE with host float64
+accumulation — right for validation-sized data between CD sweeps.  The
+serving-scale path is ``transform_streamed`` /
+``estimators.streaming_scorer``: one pass in fixed-shape chunks where a
+single fused device program scores every coordinate at once (ISSUE 4).
+The per-coordinate helpers here are shared by both paths.
 """
 
 from __future__ import annotations
@@ -37,9 +44,10 @@ Array = jax.Array
 
 
 # Below this many rows the host numpy pass beats device dispatch +
-# transfer; above it, sparse scoring streams ELL chunks through the
-# accelerator (round-4 verdict: training rode the device, scoring 10⁸
-# rows must not stay on host float64).
+# transfer; above it, scoring streams chunks through the accelerator
+# (round-4 verdict: training rode the device, scoring 10⁸ rows must not
+# stay on host float64).  Applies to the fixed-effect sparse path AND
+# (ISSUE 4 satellite) the non-projected random-effect gather-dot.
 _DEVICE_SCORE_MIN_ROWS = 200_000
 _DEVICE_SCORE_CHUNK = 2_000_000
 
@@ -80,6 +88,53 @@ def _device_score_sparse(rows, w_np: np.ndarray) -> np.ndarray:
     return np.concatenate(outs) if outs else np.zeros(0, np.float32)
 
 
+@jax.jit
+def _re_gather_dot(W_pad: Array, x: Array, idx: Array) -> Array:
+    """``out[i] = x[i] · W_pad[idx[i]]`` — the random-effect
+    coefficient-row gather-dot (the scoring-side "join" contraction;
+    ``idx`` points unseen entities at the zero padding row)."""
+    return jnp.sum(x * W_pad[idx], axis=-1)
+
+
+def _device_score_re(feats, w_pad: np.ndarray,
+                     idx: np.ndarray) -> np.ndarray:
+    """Chunked device gather+dot for the non-projected random effect
+    (ISSUE 4 satellite: the host ``np.einsum`` did this regardless of
+    size).  Same two-in-flight chunk discipline as
+    ``_device_score_sparse``; ``feats`` is a dense [n, d_re] array or
+    ``SparseRows`` (densified per chunk — RE shards are narrow)."""
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+
+    n = len(idx)
+    d_re = w_pad.shape[1]
+    grid = -(-min(n, _DEVICE_SCORE_CHUNK) // 8192) * 8192
+    W_dev = jnp.asarray(w_pad, jnp.float32)
+    pad_row = w_pad.shape[0] - 1
+    outs = []
+    pending: list = []
+    for lo in range(0, n, grid):
+        hi = min(lo + grid, n)
+        if isinstance(feats, SparseRows):
+            x = feats[lo:hi].to_dense(d_re)
+        else:
+            x = np.asarray(feats[lo:hi], np.float32)
+        if hi - lo < grid:
+            x = np.pad(x, ((0, grid - (hi - lo)), (0, 0)))
+        ix = np.full(grid, pad_row, np.int32)
+        ix[: hi - lo] = np.where(idx[lo:hi] < 0, pad_row,
+                                 idx[lo:hi]).astype(np.int32)
+        pending.append(
+            (_re_gather_dot(W_dev, jnp.asarray(x), jnp.asarray(ix)),
+             hi - lo))
+        if len(pending) >= 2:
+            out, m = pending.pop(0)
+            outs.append(np.asarray(out)[:m])
+    for out, m in pending:
+        outs.append(np.asarray(out)[:m])
+    return (np.concatenate(outs) if outs
+            else np.zeros(0, np.float32))
+
+
 def _score_fixed(model: FixedEffectModel, dataset: GameDataset) -> np.ndarray:
     feats = dataset.features[model.feature_shard]
     w_np = np.asarray(model.coefficients.means)
@@ -104,33 +159,15 @@ def _score_fixed(model: FixedEffectModel, dataset: GameDataset) -> np.ndarray:
     return rows.dot_dense(w_np.astype(np.float64)) + np.float32(base)
 
 
-def _score_random(model: RandomEffectModel, entity_ids: np.ndarray,
-                  dataset: GameDataset) -> np.ndarray:
-    from photon_ml_tpu.data.sparse_rows import SparseRows
-
-    n = dataset.n
-    idx = model.grouping.join_ids(entity_ids)
-
-    if model.projection is None:
-        feats = dataset.features[model.feature_shard]
-        x = np.asarray(feats, np.float32)
-        w_all = np.asarray(model.all_coefficients())   # [E, d_re]
-        w_pad = np.vstack([w_all, np.zeros((1, w_all.shape[1]), w_all.dtype)])
-        gathered = w_pad[idx]                           # -1 → zero row
-        return np.einsum("nd,nd->n", x, gathered).astype(np.float32)
-
-    # Projected model: score in each entity's local subspace via a
-    # sorted merge-join of (entity row, global col) keys — data side
-    # from the example features, model side from each entity's
-    # subspace — all vectorized (no per-example Python).
-    feats = dataset.features[model.feature_shard]
-    rows = SparseRows.from_rows(feats)
-    g = model.grouping
+def _projected_score_table(
+    model: RandomEffectModel) -> tuple[np.ndarray, np.ndarray]:
+    """Projected model → sorted ``(entity_row·G + global_col) → value``
+    map: the model side of the scoring merge-join, computed ONCE and
+    reused per chunk (the streaming scorer joins against it chunk by
+    chunk; ``transform`` in one shot)."""
     G = np.int64(model.projection.global_dim)
-
-    # Model side: (entity row, global col) → coefficient value.
     keys_parts, vals_parts = [], []
-    ent_row_of = g.entity_row_map()
+    ent_row_of = model.grouping.entity_row_map()
     for b, blk in enumerate(model.coefficient_blocks):
         fids = model.projection.feature_ids[b]
         blk = np.asarray(blk)
@@ -141,25 +178,71 @@ def _score_random(model: RandomEffectModel, entity_ids: np.ndarray,
         keys_parts.append(erow * G + fids[rr, cc])
         vals_parts.append(blk[rr, cc].astype(np.float64))
     if not keys_parts:
-        return np.zeros(n, np.float32)
-    key_m = np.concatenate(keys_parts)
-    val_m = np.concatenate(vals_parts)
+        return np.zeros(0, np.int64), np.zeros(0, np.float64)
+    keys = np.concatenate(keys_parts)
+    vals = np.concatenate(vals_parts)
+    order = np.argsort(keys)
+    return keys[order], vals[order]
 
-    # Data side: one key per stored entry whose example's entity
-    # trained AND whose column is inside the trained global space —
-    # out-of-space ids would alias into the next entity's key range.
+
+def _score_projected_rows(model: RandomEffectModel, table, idx, rows
+                          ) -> np.ndarray:
+    """Projected-model scores for one row range: merge-join of the
+    rows' (entity row, global col) keys against the pre-sorted model
+    table — all vectorized (no per-example Python).  ``idx`` is the
+    rows' global entity index (−1 unseen), ``table`` from
+    ``_projected_score_table``."""
     from photon_ml_tpu.game.dataset import sorted_key_join
 
+    ks, vs = table
+    n = len(rows)
+    if ks.size == 0:
+        return np.zeros(n, np.float32)
+    G = np.int64(model.projection.global_dim)
+    # One key per stored entry whose example's entity trained AND whose
+    # column is inside the trained global space — out-of-space ids
+    # would alias into the next entity's key range.
     row_of = rows.row_of()
     erow_nnz = idx[row_of]
     dsel = (erow_nnz >= 0) & (rows.cols.astype(np.int64) < G)
     key_d = erow_nnz[dsel] * G + rows.cols[dsel].astype(np.int64)
-    w_at, hit = sorted_key_join(key_m, val_m, key_d)
+    w_at, hit = sorted_key_join(ks, vs, key_d, presorted=True)
     contrib = np.zeros(rows.nnz, np.float64)
     contrib[dsel] = np.where(hit, w_at, 0.0) * rows.vals[dsel]
     cs = np.zeros(rows.nnz + 1, np.float64)
     np.cumsum(contrib, out=cs[1:])
     return (cs[rows.indptr[1:]] - cs[rows.indptr[:-1]]).astype(np.float32)
+
+
+def _score_random(model: RandomEffectModel, entity_ids: np.ndarray,
+                  dataset: GameDataset) -> np.ndarray:
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+
+    n = dataset.n
+    idx = model.grouping.join_ids(entity_ids)
+
+    if model.projection is None:
+        feats = dataset.features[model.feature_shard]
+        w_all = np.asarray(model.all_coefficients())   # [E, d_re]
+        w_pad = np.vstack([w_all, np.zeros((1, w_all.shape[1]), w_all.dtype)])
+        if (n >= _DEVICE_SCORE_MIN_ROWS
+                and jax.default_backend() != "cpu"):
+            # Large inputs ride the accelerator (gather+dot chunks) —
+            # the sparse fixed-effect discipline, applied to the RE
+            # coefficient-row gather (ISSUE 4 satellite).
+            return _device_score_re(feats, w_pad, idx)
+        x = np.asarray(feats, np.float32)
+        gathered = w_pad[idx]                           # -1 → zero row
+        return np.einsum("nd,nd->n", x, gathered).astype(np.float32)
+
+    # Projected model: score in each entity's local subspace via a
+    # sorted merge-join of (entity row, global col) keys — data side
+    # from the example features, model side from each entity's
+    # subspace.
+    feats = dataset.features[model.feature_shard]
+    rows = SparseRows.from_rows(feats)
+    table = _projected_score_table(model)
+    return _score_projected_rows(model, table, idx, rows)
 
 
 @dataclasses.dataclass
@@ -181,6 +264,26 @@ class GameTransformer:
             else:
                 raise TypeError(f"unknown component model {type(comp)}")
         return total.astype(np.float32)
+
+    def transform_streamed(self, dataset: GameDataset,
+                           score_chunk_rows: int = 1 << 20,
+                           spill_dir: str | None = None,
+                           host_max_resident: int = 2,
+                           prefetch_depth: int = 2) -> np.ndarray:
+        """Margins via the one-pass fused chunk pipeline
+        (``estimators.streaming_scorer``) — identical to ``transform``
+        up to float-summation order, with memory bounded by the chunk
+        window instead of per-coordinate full passes."""
+        from photon_ml_tpu.estimators.streaming_scorer import (
+            StreamingGameScorer,
+        )
+
+        scorer = StreamingGameScorer(
+            model=self.model, task=self.task,
+            chunk_rows=score_chunk_rows, spill_dir=spill_dir,
+            host_max_resident=host_max_resident,
+            prefetch_depth=prefetch_depth)
+        return scorer.score(dataset, keep_margins=True)["margins"]
 
     def transform_mean(self, dataset: GameDataset) -> np.ndarray:
         """Mean-space predictions (sigmoid/identity/exp of margins)."""

@@ -18,13 +18,23 @@ from photon_ml_tpu.evaluation.evaluators import (
     rmse,
     squared_loss,
 )
+from photon_ml_tpu.evaluation.streaming import (
+    StreamingAUC,
+    StreamingMeanLoss,
+    StreamingRMSE,
+    make_streaming_evaluator,
+)
 
 __all__ = [
     "EvaluatorType",
+    "StreamingAUC",
+    "StreamingMeanLoss",
+    "StreamingRMSE",
     "auc",
     "better_than",
     "evaluate",
     "logistic_loss",
+    "make_streaming_evaluator",
     "poisson_loss",
     "rmse",
     "squared_loss",

@@ -98,19 +98,26 @@ class EntityGrouping:
 
 
 def sorted_key_join(
-    keys: np.ndarray, vals: np.ndarray, query_keys: np.ndarray
+    keys: np.ndarray, vals: np.ndarray, query_keys: np.ndarray,
+    presorted: bool = False,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Value of each query key under the (unique-keyed) ``keys → vals``
     map: returns ``(values, hit)`` where ``hit[i]`` is False (and the
-    value meaningless) for absent keys.  ``keys`` need not be pre-sorted.
-    The merge-join primitive behind projected-model scoring and
-    warm-start import (packed ``entity·G + col`` int64 keys)."""
+    value meaningless) for absent keys.  ``keys`` need not be
+    pre-sorted unless ``presorted`` is set — the streaming scorer joins
+    many chunks against ONE pre-sorted model table and must not pay an
+    argsort per chunk.  The merge-join primitive behind projected-model
+    scoring and warm-start import (packed ``entity·G + col`` int64
+    keys)."""
     nq = len(query_keys)
     if len(keys) == 0:
         return np.zeros(nq, vals.dtype if len(vals) else np.float64), \
             np.zeros(nq, bool)
-    order = np.argsort(keys)
-    ks, vs = keys[order], vals[order]
+    if presorted:
+        ks, vs = keys, vals
+    else:
+        order = np.argsort(keys)
+        ks, vs = keys[order], vals[order]
     p = np.minimum(np.searchsorted(ks, query_keys), len(ks) - 1)
     return vs[p], ks[p] == query_keys
 

@@ -90,18 +90,24 @@ def _place_chunk(chunk, mesh):
     return jax.tree.map(asm, *chunk)
 
 
-class _ChunkPrefetcher:
+class ChunkPrefetcher:
     """Background disk → host → device pipeline stage.
 
     One thread walks the sweep's chunk order ahead of the consumer:
-    ``batch.chunk(i)`` pulls the host pieces (the chunk store's disk
-    read / LRU window), ``_place_chunk`` starts the ASYNC host→device
-    transfer, and the (host, device) pair lands in a bounded queue of
-    depth ``depth`` — so chunk i's device compute overlaps chunk
+    ``load(i)`` pulls the host pieces (the chunk store's disk read /
+    LRU window), ``place`` starts the ASYNC host→device transfer, and
+    the (host, device) pair lands in a bounded queue of depth
+    ``depth`` — so chunk i's device compute overlaps chunk
     i+1..i+depth's disk reads AND transfers, the third pipeline level
     in front of the classic device double-buffer.  The host reference
     rides in the queue item until the consumer takes it, so the LRU
     window can never free arrays out from under an in-flight copy.
+
+    Generic over the chunk source since ISSUE 4 (``load``/``place``
+    callables + optional ``store`` for reader accounting): the training
+    objective feeds it ``ChunkedBatch.chunk`` + the mesh-aware
+    placement, the streaming scorer its score-chunk store reader +
+    plain ``device_put``.
 
     Determinism: the queue preserves the thread's (sweep) order and
     ``next(expect)`` asserts it — the chunk visit order the parity and
@@ -112,16 +118,18 @@ class _ChunkPrefetcher:
 
     _SENTINEL = object()
 
-    def __init__(self, batch, mesh, depth: int):
-        self._batch = batch
-        self._mesh = mesh
+    def __init__(self, load, place, depth: int, store=None):
+        self._load = load
+        self._place = place
+        self._store = store
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
 
     def start(self, order) -> None:
-        self._batch.store.begin_read()
+        if self._store is not None:
+            self._store.begin_read()
         self._thread = threading.Thread(
             target=self._run, args=(list(order),), daemon=True,
             name="photon-chunk-prefetch")
@@ -141,15 +149,16 @@ class _ChunkPrefetcher:
             for i in order:
                 if self._stop.is_set():
                     return
-                host = self._batch.chunk(i)          # disk -> host
-                buf = _place_chunk(host, self._mesh)  # host -> device
+                host = self._load(i)                 # disk -> host
+                buf = self._place(host)              # host -> device
                 if not self._put((i, host, buf)):
                     return
         except BaseException as e:   # surfaced at the consumer's next()
             self._error = e
             self._put((self._SENTINEL, None, None))
         finally:
-            self._batch.store.end_read()
+            if self._store is not None:
+                self._store.end_read()
 
     def next(self, expect: int):
         """The next placed chunk; raises the producer's error, and
@@ -177,6 +186,11 @@ class _ChunkPrefetcher:
                 t.join(timeout=0.05)
         t.join()
         self._thread = None
+
+
+# Historical name (round 8); the class went public when the streaming
+# scorer started reusing it.
+_ChunkPrefetcher = ChunkPrefetcher
 
 
 # ---------------------------------------------------------------------------
@@ -359,8 +373,10 @@ class ChunkedGLMObjective:
         if k == 0:
             return
         if self.batch.store is not None and self.prefetch_depth > 0:
-            pf = _ChunkPrefetcher(self.batch, self._mesh,
-                                  self.prefetch_depth)
+            pf = ChunkPrefetcher(
+                self.batch.chunk,
+                lambda host: _place_chunk(host, self._mesh),
+                self.prefetch_depth, store=self.batch.store)
             self._active_prefetcher = pf
             pf.start(range(k))
             try:

@@ -150,7 +150,7 @@ def shard_sparse_batch(
     """
     from photon_ml_tpu.data.batch import make_sparse_batch
     from photon_ml_tpu.data.colmajor import build_colmajor, choose_capacity
-
+    from photon_ml_tpu.data.grr import collect_spill_warnings
     from photon_ml_tpu.data.sparse_rows import SparseRows
 
     if layout is None:
@@ -173,65 +173,71 @@ def shard_sparse_batch(
     offsets = np.zeros(n) if offsets is None else np.asarray(offsets)
 
     shards = []
-    for i in range(n_dev):
-        lo, hi = i * per, min((i + 1) * per, n)
-        shards.append(
-            make_sparse_batch(
-                rows[lo:hi],
-                dim,
-                np.asarray(labels)[lo:hi],
-                weights=weights[lo:hi],
-                offsets=offsets[lo:hi],
-                row_capacity=k,
-                pad_to=per,
-            )
-        )
-
-    if col_major:
-        if col_capacity is None:
-            if isinstance(rows, SparseRows):
-                all_cols = rows.cols
-            else:
-                all_cols = (
-                    np.concatenate([np.asarray(c) for c, _ in rows])
-                    if len(rows) else np.zeros(0, np.int64)
+    # One spill-warning aggregation scope over the whole sharded build
+    # (per-shard batch builds + the sharded plan set below): one
+    # summary line per build, never one per shard sub-plan (ISSUE 4
+    # satellite; MULTICHIP_r05's tail printed 15+).
+    with collect_spill_warnings():
+        for i in range(n_dev):
+            lo, hi = i * per, min((i + 1) * per, n)
+            shards.append(
+                make_sparse_batch(
+                    rows[lo:hi],
+                    dim,
+                    np.asarray(labels)[lo:hi],
+                    weights=weights[lo:hi],
+                    offsets=offsets[lo:hi],
+                    row_capacity=k,
+                    pad_to=per,
                 )
-            counts = np.bincount(all_cols, minlength=dim)
-            col_capacity = choose_capacity(counts)
-        # Per-shard virtual-row counts (cheap bincounts) → common padded
-        # shape, so build_colmajor emits equal-shape shards directly.
-        shard_counts = [
-            np.bincount(
-                np.asarray(b.col_ids).reshape(-1)[
-                    np.asarray(b.values).reshape(-1) != 0
-                ],
-                minlength=dim,
             )
-            for b in shards
-        ]
-        from photon_ml_tpu.ops.kernels import vrow_pad
 
-        v_max = max(
-            int((-(-c // col_capacity)).sum()) for c in shard_counts
-        )
-        v_max = vrow_pad(v_max, None)
-        shards = [
-            b.replace(colmajor=build_colmajor(
-                np.asarray(b.col_ids), np.asarray(b.values), dim,
-                capacity=col_capacity, pad_vrows_to=v_max,
-            ))
-            for b in shards
-        ]
-    elif layout == "grr":
-        from photon_ml_tpu.data.grr import build_sharded_grr_pairs
+        if col_major:
+            if col_capacity is None:
+                if isinstance(rows, SparseRows):
+                    all_cols = rows.cols
+                else:
+                    all_cols = (
+                        np.concatenate([np.asarray(c) for c, _ in rows])
+                        if len(rows) else np.zeros(0, np.int64)
+                    )
+                counts = np.bincount(all_cols, minlength=dim)
+                col_capacity = choose_capacity(counts)
+            # Per-shard virtual-row counts (cheap bincounts) → common
+            # padded shape, so build_colmajor emits equal-shape shards
+            # directly.
+            shard_counts = [
+                np.bincount(
+                    np.asarray(b.col_ids).reshape(-1)[
+                        np.asarray(b.values).reshape(-1) != 0
+                    ],
+                    minlength=dim,
+                )
+                for b in shards
+            ]
+            from photon_ml_tpu.ops.kernels import vrow_pad
 
-        pairs = build_sharded_grr_pairs(
-            [np.asarray(b.col_ids) for b in shards],
-            [np.asarray(b.values) for b in shards],
-            dim,
-            cache_dir=cache_dir,
-        )
-        shards = [b.replace(grr=p) for b, p in zip(shards, pairs)]
+            v_max = max(
+                int((-(-c // col_capacity)).sum()) for c in shard_counts
+            )
+            v_max = vrow_pad(v_max, None)
+            shards = [
+                b.replace(colmajor=build_colmajor(
+                    np.asarray(b.col_ids), np.asarray(b.values), dim,
+                    capacity=col_capacity, pad_vrows_to=v_max,
+                ))
+                for b in shards
+            ]
+        elif layout == "grr":
+            from photon_ml_tpu.data.grr import build_sharded_grr_pairs
+
+            pairs = build_sharded_grr_pairs(
+                [np.asarray(b.col_ids) for b in shards],
+                [np.asarray(b.values) for b in shards],
+                dim,
+                cache_dir=cache_dir,
+            )
+            shards = [b.replace(grr=p) for b, p in zip(shards, pairs)]
 
     devices = list(mesh.devices.flat)
     sharding = NamedSharding(mesh, batch_spec())

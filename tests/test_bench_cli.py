@@ -116,6 +116,36 @@ def test_bench_stream_section_contract(tmp_path):
     assert rec["peak_rss_mb"]["stream"] > 0
 
 
+def test_bench_score_section_contract(tmp_path):
+    """`--section score` keeps the budget/JSON-last-line contract and
+    records the streaming-fused-scoring measurement (ISSUE 4): per-arm
+    rows/s and peak host RSS (each arm in its own subprocess),
+    streamed-vs-resident margin parity, and the pass-time ratio."""
+    proc = _run_bench(tmp_path, "--section", "score",
+                      "--budget-s", "240", *_TINY)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert rec["section"] == "score"
+    assert rec.get("errors") is None
+    s = rec["score"]
+    # Chunks must dwarf the streamed arm's host window (the bounded-RSS
+    # claim's precondition).
+    assert s["n_chunks"] >= 6 * s["host_max_resident"]
+    for arm in ("streamed", "resident"):
+        assert s[arm]["pass_ms"] > 0
+        assert s[arm]["rows_per_sec"] > 0
+        assert s[arm]["peak_rss_mb"] > 0
+    assert s["streamed"]["chunk_rows"] * s["n_chunks"] >= 4096
+    # LRU window bound held during the streamed arm's timed passes.
+    assert 1 <= s["streamed"]["peak_live_chunks"] <= 2
+    assert s["margin_parity_max"] < 1e-4
+    assert s["pass_time_ratio"] is not None
+    # Satellite discipline from round 8: every section records the RSS
+    # high-water trajectory.
+    assert rec["peak_rss_mb"]["score"] > 0
+
+
 def test_bench_zero_budget_still_emits_json(tmp_path):
     """A hopeless budget skips every section but the process still
     exits 0 with one parseable JSON line recording the skips."""

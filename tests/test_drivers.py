@@ -375,6 +375,113 @@ def test_scoring_unseen_entities_and_oov_features(tmp_path):
     assert abs(out["scores"][0] - out["scores"][1]) > 1e-3
 
 
+def test_scoring_driver_avro_roundtrip_and_streamed_parity(tmp_path):
+    """ISSUE 4 satellite: scoring-driver end-to-end Avro round trip
+    (schema fields, entity ids, prediction-space values) plus the
+    streamed pipeline reproducing the resident driver output through a
+    config with streaming knobs (npz AND avro sinks, spill tier on)."""
+    train_path = str(tmp_path / "train.jsonl")
+    data = _write_jsonl_fixture(train_path, n_users=20, n_obs=600,
+                                seed=23)
+    config = {
+        "task_type": "LOGISTIC_REGRESSION",
+        "coordinates": [
+            {"name": "global", "kind": "FIXED_EFFECT",
+             "feature_shard": "global",
+             "optimizer": {"reg_weight": 1.0, "max_iters": 60}},
+            {"name": "per_user", "kind": "RANDOM_EFFECT",
+             "feature_shard": "user_re", "entity_key": "userId",
+             "optimizer": {"reg_weight": 2.0, "max_iters": 30}},
+        ],
+        "update_sequence": ["global", "per_user"],
+        "input_path": train_path,
+        "dense_feature_shards": ["global", "user_re"],
+        "output_dir": str(tmp_path / "out"),
+        "evaluators": [],
+    }
+    cfg_path = str(tmp_path / "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(config, f)
+    game_training_driver.main(["--config", cfg_path])
+
+    def score(**overrides):
+        sc = {"input_path": train_path,
+              "model_dir": str(tmp_path / "out" / "model"),
+              "evaluators": ["AUC", "RMSE", "LOGISTIC_LOSS"]}
+        sc.update(overrides)
+        path = str(tmp_path / "sc.json")
+        with open(path, "w") as f:
+            json.dump(sc, f)
+        return game_scoring_driver.main(["--config", path])
+
+    # Resident reference (npz).
+    res = score(output_path=str(tmp_path / "resident.npz"))
+    ref = np.load(str(tmp_path / "resident.npz"))
+
+    # Avro round trip: ScoringResultAvro fields through the generic
+    # container reader.
+    from photon_ml_tpu.io.avro import read_container
+
+    score(output_path=str(tmp_path / "scores.avro"))
+    _, recs = read_container(str(tmp_path / "scores.avro"))
+    recs = list(recs)
+    assert len(recs) == len(ref["scores"])
+    assert set(recs[0]) == {"uid", "predictionScore", "label", "ids"}
+    assert [r["uid"] for r in recs[:5]] == [0, 1, 2, 3, 4]
+    np.testing.assert_allclose(
+        [r["predictionScore"] for r in recs], ref["predictions"],
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        [r["label"] for r in recs], ref["labels"], atol=1e-9)
+    # Entity-id map: every record tags its (index-mapped) userId.
+    uid_col = np.asarray(
+        [int(r["ids"]["userId"]) for r in recs])
+    assert len(np.unique(uid_col)) == len(np.unique(data["user_ids"]))
+
+    # Streamed arm (npz + spill + streaming evaluation): same scores,
+    # same evaluation to tolerance.
+    streamed = score(output_path=str(tmp_path / "streamed.npz"),
+                     score_chunk_rows=128,
+                     spill_dir=str(tmp_path / "spill"),
+                     host_max_resident=1, prefetch_depth=2)
+    out = np.load(str(tmp_path / "streamed.npz"))
+    np.testing.assert_allclose(out["scores"], ref["scores"], atol=2e-5)
+    np.testing.assert_allclose(out["predictions"], ref["predictions"],
+                               atol=2e-5)
+    np.testing.assert_array_equal(out["labels"], ref["labels"])
+    for k, v in res["evaluation"].items():
+        assert abs(streamed["evaluation"][k] - v) < 5e-4, k
+    assert os.path.isdir(tmp_path / "spill" / "chunks")
+
+    # Streamed avro equals resident avro record-for-record.
+    score(output_path=str(tmp_path / "streamed.avro"),
+          score_chunk_rows=128)
+    _, recs_s = read_container(str(tmp_path / "streamed.avro"))
+    recs_s = list(recs_s)
+    np.testing.assert_allclose(
+        [r["predictionScore"] for r in recs_s],
+        [r["predictionScore"] for r in recs], atol=2e-5)
+    assert [r["ids"] for r in recs_s[:20]] == [r["ids"]
+                                               for r in recs[:20]]
+
+
+def test_scoring_config_validation():
+    from photon_ml_tpu.config import scoring_config_from_json
+
+    with pytest.raises(ValueError, match="score_chunk_rows"):
+        scoring_config_from_json(json.dumps({
+            "input_path": "x", "model_dir": "m",
+            "score_chunk_rows": 0}))
+    with pytest.raises(ValueError, match="spill_dir requires"):
+        scoring_config_from_json(json.dumps({
+            "input_path": "x", "model_dir": "m", "spill_dir": "/tmp/s"}))
+    cfg = scoring_config_from_json(json.dumps({
+        "input_path": "x", "model_dir": "m",
+        "score_chunk_rows": 4096, "spill_dir": "/tmp/s",
+        "prefetch_depth": 0}))
+    assert cfg.score_chunk_rows == 4096
+
+
 def test_read_libsvm_drops_out_of_range_indices(tmp_path):
     from photon_ml_tpu.io.libsvm import read_libsvm
 

@@ -676,3 +676,56 @@ def test_spill_warning_rate_limited(caplog):
         _spill_warnings.note(20, 100)           # outside a build scope
         assert len(caplog.records) == 1
         assert "20.0% (20 of 100)" in caplog.records[0].getMessage()
+
+
+def test_spill_warning_aggregates_across_sharded_builds(caplog):
+    """Satellite (round 9): a multi-build operation — several plan
+    builds inside one ``collect_spill_warnings`` scope, the shape of
+    ``build_chunked_batch``/``shard_sparse_batch`` — emits ONE summary
+    for the whole sharded build, not one line per sub-plan (the
+    MULTICHIP_r05 tail printed 15+)."""
+    import logging
+
+    from photon_ml_tpu.data.grr import (
+        _spill_warnings,
+        collect_spill_warnings,
+    )
+
+    with caplog.at_level(logging.WARNING, logger="photon_ml_tpu.data.grr"):
+        caplog.clear()
+        with collect_spill_warnings():
+            for _ in range(3):            # three sibling plan builds
+                with _spill_warnings:     # each with its own scope
+                    for _ in range(5):    # five direction builds each
+                        _spill_warnings.note(20, 100)
+            assert not caplog.records     # silent until outermost exit
+        assert len(caplog.records) == 1
+        assert "15 of 15 direction builds" in \
+            caplog.records[0].getMessage()
+
+
+def test_chunked_grr_build_one_spill_summary(rng, caplog):
+    """The real path: a GRR-layout chunked build (per-chunk sub-plans
+    through build_sharded_grr_pairs) logs at most one spill summary."""
+    import logging
+
+    from photon_ml_tpu.data.chunked_batch import build_chunked_batch
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+
+    n, d, k = 2048, 4000, 6
+    x0 = d / 14.0
+    u = rng.uniform(size=(n, k))
+    cols = np.minimum(x0 * np.exp(u * np.log((d + x0) / x0)) - x0,
+                      d - 1).astype(np.int64)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    rows = SparseRows.from_flat(np.arange(n + 1, dtype=np.int64) * k,
+                                cols.reshape(-1), vals.reshape(-1))
+    labels = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    with caplog.at_level(logging.WARNING,
+                         logger="photon_ml_tpu.data.grr"):
+        caplog.clear()
+        build_chunked_batch(rows, d, labels, n_chunks=4, layout="grr",
+                            row_capacity=k)
+        spill_lines = [r for r in caplog.records
+                       if "spill fraction" in r.getMessage()]
+        assert len(spill_lines) <= 1
