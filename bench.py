@@ -71,7 +71,7 @@ V5E_PEAK_GBPS = 819.0
 
 DEFAULT_SECTIONS = ("etl", "cached", "grr", "segment_sum", "colmajor")
 ALL_SECTIONS = DEFAULT_SECTIONS + ("powerlaw", "chunked", "sweep",
-                                   "stream", "score")
+                                   "stream", "score", "re")
 DEFAULT_BUDGET_S = 840.0
 DEFAULT_N, DEFAULT_D, DEFAULT_K = 1_000_000, 100_000, 30
 
@@ -93,6 +93,19 @@ SCORE_WINDOW = 2
 SCORE_DEPTH = 2
 SCORE_PASSES = 3
 SCORE_D_RE = 4
+
+# Streamed-RE section shape (ISSUE 5): entity chunks must dwarf the LRU
+# host window (same discipline as the stream/score sections), and the
+# per-entity offset schedule decays at entity-specific rates so the
+# converged-entity retirement curve is GRADUAL — entities cross the
+# movement tolerance on different sweeps, the shape a converging CD
+# endgame actually produces.
+RE_CHUNKS = 24          # target entity chunks (window 2 → 12×)
+RE_WINDOW = 2
+RE_DEPTH = 2
+RE_SWEEPS = 8
+RE_D = 8                # dense RE feature width
+RE_TOL = 1e-4           # solver tolerance = retirement threshold
 
 # λ-sweep section shape: lanes × solver-iteration cap (kept static so
 # the batched and sequential arms solve the identical problem set).
@@ -122,6 +135,9 @@ SECTION_EST_S = {
     # Two subprocess arms × (score-chunk ETL + 1 warm + SCORE_PASSES
     # timed one-pass scores).
     "score": 300.0,
+    # Two subprocess arms × (entity-chunk ETL + RE_SWEEPS vmapped
+    # bucket solves over the full dataset).
+    "re": 420.0,
 }
 
 
@@ -264,7 +280,7 @@ class BenchContext:
 
     def estimate(self, section: str) -> float:
         est = SECTION_EST_S[section] * self.scale
-        if section in ("stream", "score"):
+        if section in ("stream", "score", "re"):
             # Two subprocess arms pay a fixed jax-import + compile cost
             # each, regardless of shape.
             est += 60.0
@@ -1124,6 +1140,242 @@ def section_score(ctx: BenchContext) -> None:
           file=sys.stderr)
 
 
+def _make_re_workload(n: int, seed: int = 9):
+    """Synthetic random-effect workload with power-law-ish entity skew
+    (a long tail of small entities + a head of heavy ones → several
+    size buckets) and per-entity offset decay rates for the retirement
+    curve.  Returns (dataset, entity decay rates, base offset noise)."""
+    from photon_ml_tpu.game.dataset import GameDataset
+
+    rng = np.random.default_rng(seed)
+    e_small = max(8, n // 64)
+    e_big = max(2, e_small // 16)
+    n_small = (3 * n) // 4
+    ids = np.concatenate([
+        rng.integers(0, e_small, n_small),
+        rng.integers(e_small, e_small + e_big, n - n_small),
+    ]).astype(np.int64)
+    E = e_small + e_big
+    x = rng.normal(0, 1, (n, RE_D)).astype(np.float32)
+    w_true = rng.normal(0, 0.5, (E, RE_D)).astype(np.float32)
+    margins = np.einsum("np,np->n", x, w_true[ids])
+    labels = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-margins)))
+    dataset = GameDataset(labels=labels.astype(np.float32),
+                          features={"re": x}, entity_ids={"u": ids})
+    decay = rng.uniform(0.05, 0.6, E).astype(np.float32)
+    base = rng.normal(0, 0.3, n).astype(np.float32)
+    return dataset, ids, decay, base
+
+
+def re_arm_main(args) -> int:
+    """One arm of the ``re`` section in its OWN process (per-arm
+    ``ru_maxrss`` honesty, as in ``stream_arm_main``): RE_SWEEPS
+    emulated CD sweeps — per-entity offsets decay at entity-specific
+    rates toward a fixed point, the converging-endgame shape — over
+    the streamed (chunk store + prefetch + retirement) or resident
+    random-effect coordinate.  Emits one JSON line; saves the final
+    coefficients and scores for the parent's cross-arm parity check."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.normalization import NormalizationContext
+    from photon_ml_tpu.game.coordinates import (
+        build_random_effect_coordinate,
+        build_streamed_random_effect_coordinate,
+    )
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optim import OptimizerConfig
+
+    arm = args.re_arm
+    n = args.n
+    dataset, ids, decay, base = _make_re_workload(n)
+    E = len(decay)
+    obj = GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=RegularizationContext.l2(1.0),
+        norm=NormalizationContext.identity(),
+    )
+    cfg = OptimizerConfig(max_iters=60, tolerance=RE_TOL)
+    base_mb = _current_rss_mb()
+    base_anon_mb = _current_rss_mb("RssAnon")
+
+    t0 = time.time()
+    if arm == "streamed":
+        chunk_entities = max(1, -(-E // RE_CHUNKS))
+        coord = build_streamed_random_effect_coordinate(
+            "u", dataset, "re", obj, config=cfg,
+            spill_dir=os.path.join(args.cache_dir, "spill_re"),
+            chunk_entities=chunk_entities,
+            host_max_resident=RE_WINDOW, prefetch_depth=RE_DEPTH,
+            retirement=True)
+    else:
+        coord = build_random_effect_coordinate(
+            "u", dataset, "re", obj, config=cfg)
+    etl_s = time.time() - t0
+
+    per_ex_decay = decay[ids]
+    times, solved, retired = [], [], []
+    w = None
+    scores = None
+
+    def sweep(s):
+        nonlocal w, scores
+        # Squared exponent: per-entity offset deltas cross the
+        # retirement tolerance on DIFFERENT sweeps (fast-decay
+        # entities around sweep 3, slow ones near the end) — the
+        # gradual work-reduction curve of a real CD endgame.
+        off = jnp.asarray(base * (per_ex_decay ** (2 * s)))
+        t0 = time.time()
+        w, diag = coord.train(off, w)
+        scores = coord.score(w)
+        jax.block_until_ready(scores)
+        times.append(time.time() - t0)
+        if isinstance(diag, dict):               # streamed coordinate
+            solved.append(int(diag["entities_solved"]))
+            retired.append(int(diag["entities_retired"]))
+            coord.retire_converged()             # the CD hook
+        else:
+            solved.append(E)
+            retired.append(0)
+
+    # Sweep 0 runs OUTSIDE the RSS sampler: it pays the one-time
+    # per-bucket XLA compiles, whose allocator spike would set BOTH
+    # arms' high-water and mask the training-regime residency
+    # difference this section exists to measure (the round-8 stream
+    # section's rule).
+    sweep(0)
+    with _RssSampler() as rss:
+        for s in range(1, RE_SWEEPS):
+            sweep(s)
+    # Sweep 0 pays the per-bucket XLA compiles; the steady-state number
+    # is the median of the remaining sweeps.
+    sweep_s = float(np.median(times[1:])) if len(times) > 1 else times[0]
+    np.save(os.path.join(args.cache_dir, f"re_coefs_{arm}.npy"),
+            np.concatenate([np.asarray(b).ravel() for b in w]))
+    np.save(os.path.join(args.cache_dir, f"re_scores_{arm}.npy"),
+            np.asarray(scores))
+
+    peak = _peak_rss_mb()
+    anon = _current_rss_mb("RssAnon")
+    rec = {
+        "arm": arm,
+        "etl_s": round(etl_s, 1),
+        "entities": E,
+        "sweeps": RE_SWEEPS,
+        "sweep_s": round(sweep_s, 3),
+        "sweep_s_all": [round(t, 3) for t in times],
+        "rows_per_sec": round(n / sweep_s, 1),
+        "entities_per_sec": round(E / sweep_s, 1),
+        "entities_solved_per_sweep": solved,
+        "entities_retired_per_sweep": retired,
+        "peak_rss_mb": round(peak, 1),
+        "sweep_peak_rss_mb": round(rss.peak_mb, 1),
+        "rss_delta_mb": (round(rss.peak_mb - base_mb, 1)
+                         if base_mb is not None else None),
+        "anon_delta_mb": (round(anon - base_anon_mb, 1)
+                          if anon is not None
+                          and base_anon_mb is not None else None),
+    }
+    if arm == "streamed":
+        store = coord.store
+        rec.update({
+            "n_chunks": store.n_chunks,
+            "chunk_entities": coord.chunk_entities,
+            "host_max_resident": RE_WINDOW,
+            "prefetch_depth": RE_DEPTH,
+            "peak_live_chunks": store.peak_resident,
+            "disk_loads": store.loads,
+            "window_hits": store.hits,
+            "spill_files_mb": round(sum(
+                os.path.getsize(store.path(i))
+                for i in range(store.n_chunks) if store.has(i)) / 1e6, 1),
+        })
+    print(json.dumps(rec))
+    return 0
+
+
+def section_re(ctx: BenchContext) -> None:
+    """Out-of-core random-effect training (ISSUE 5 tentpole
+    measurement): the SAME emulated converging CD sweeps run twice —
+    streamed (disk-backed entity chunks, LRU window, prefetch,
+    converged-entity retirement) and resident — each arm in its own
+    subprocess for honest per-arm peak RSS.  Claims under test: final
+    coefficients/scores match to float tolerance despite retirement,
+    live window ≤ host_max_resident, retirement reduces per-sweep
+    solved entities monotonically on the converging schedule."""
+    import shutil
+    import subprocess
+
+    shutil.rmtree(os.path.join(ctx.cache_dir, "spill_re"),
+                  ignore_errors=True)   # honest cold spill ETL
+
+    def run_arm(arm: str) -> dict:
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--re-arm", arm, "--n", str(ctx.n), "--d", str(ctx.d),
+             "--k", str(ctx.k), "--cache-dir", ctx.cache_dir]
+            + (["--no-compile-cache"] if ctx.no_compile_cache else []),
+            capture_output=True, text=True,
+            timeout=max(60.0, ctx.remaining()),
+        )
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            raise RuntimeError(f"re arm {arm!r} failed "
+                               f"(rc={proc.returncode}): "
+                               f"{proc.stderr[-500:]}")
+        rec = json.loads(
+            [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+        rec["arm_wall_s"] = round(time.time() - t0, 1)
+        return rec
+
+    streamed = run_arm("streamed")
+    resident = run_arm("resident")
+    c_s = np.load(os.path.join(ctx.cache_dir, "re_coefs_streamed.npy"))
+    c_r = np.load(os.path.join(ctx.cache_dir, "re_coefs_resident.npy"))
+    s_s = np.load(os.path.join(ctx.cache_dir, "re_scores_streamed.npy"))
+    s_r = np.load(os.path.join(ctx.cache_dir, "re_scores_resident.npy"))
+    coef_parity = float(np.max(np.abs(c_s - c_r))) if len(c_s) else 0.0
+    score_parity = float(np.max(np.abs(s_s - s_r))) if len(s_s) else 0.0
+
+    def ratio(a, b):
+        if a is None or b is None or b == 0:
+            return None
+        return round(a / b, 2)
+
+    solved = streamed["entities_solved_per_sweep"]
+    ctx.record["re"] = {
+        "n_chunks": streamed.get("n_chunks"),
+        "host_max_resident": RE_WINDOW,
+        "prefetch_depth": RE_DEPTH,
+        "sweeps": RE_SWEEPS,
+        "streamed": streamed,
+        "resident": resident,
+        "coef_parity_max": coef_parity,
+        "score_parity_max": score_parity,
+        # Retirement work reduction: solved entities on the last sweep
+        # as a fraction of the first (monotone ↓ on this schedule).
+        "retirement_work_fraction": (round(solved[-1] / solved[0], 4)
+                                     if solved and solved[0] else None),
+        "sweep_time_ratio": ratio(streamed["sweep_s"],
+                                  resident["sweep_s"]),
+        "peak_rss_ratio": ratio(resident["peak_rss_mb"],
+                                streamed["peak_rss_mb"]),
+        "rss_delta_ratio": ratio(resident["rss_delta_mb"],
+                                 streamed["rss_delta_mb"]),
+    }
+    r = ctx.record["re"]
+    print(f"re: streamed {streamed['sweep_s']}s/sweep "
+          f"({streamed['rows_per_sec']} rows/s, peak RSS "
+          f"{streamed['peak_rss_mb']} MB, window "
+          f"{streamed['peak_live_chunks']}/{streamed.get('n_chunks')} "
+          f"chunks) vs resident {resident['sweep_s']}s/sweep (peak "
+          f"{resident['peak_rss_mb']} MB); solved/sweep {solved}; "
+          f"coef parity {coef_parity:.2e}", file=sys.stderr)
+
+
 SECTION_FNS = {
     "etl": section_etl,
     "cached": section_cached,
@@ -1135,6 +1387,7 @@ SECTION_FNS = {
     "sweep": section_sweep,
     "stream": section_stream,
     "score": section_score,
+    "re": section_re,
 }
 
 
@@ -1207,6 +1460,10 @@ def main(argv: list[str] | None = None) -> int:
                    default=None,
                    help="internal: run ONE arm of the score section "
                         "in this process (per-arm peak-RSS isolation)")
+    p.add_argument("--re-arm", choices=("streamed", "resident"),
+                   default=None,
+                   help="internal: run ONE arm of the re section "
+                        "in this process (per-arm peak-RSS isolation)")
     args = p.parse_args(argv)
     if args.cache_dir is None:
         # Per-user default: a fixed shared-/tmp path would let another
@@ -1231,6 +1488,8 @@ def main(argv: list[str] | None = None) -> int:
         return stream_arm_main(args)
     if args.score_arm:
         return score_arm_main(args)
+    if args.re_arm:
+        return re_arm_main(args)
 
     import jax
 

@@ -256,6 +256,16 @@ def main(argv: list[str] | None = None) -> dict:
                         help="override config prefetch_depth: chunks "
                              "prefetched disk->host->device ahead of "
                              "compute (0 disables the thread)")
+    parser.add_argument("--re-chunk-entities", type=int, default=None,
+                        help="override config re_chunk_entities: "
+                             "out-of-core random-effect training — "
+                             "entities per streamed chunk per size "
+                             "bucket (requires a spill dir)")
+    parser.add_argument("--re-retirement", choices=("on", "off"),
+                        default=None,
+                        help="override config re_retirement: freeze "
+                             "converged entities between CD sweeps "
+                             "(streamed random effects only)")
     args = parser.parse_args(argv)
     config = load_training_config(args.config)
     if args.output_dir:
@@ -266,6 +276,13 @@ def main(argv: list[str] | None = None) -> dict:
         config.host_max_resident = args.host_max_resident
     if args.prefetch_depth is not None:
         config.prefetch_depth = args.prefetch_depth
+    if args.re_chunk_entities is not None:
+        config.re_chunk_entities = args.re_chunk_entities
+    if args.re_retirement is not None:
+        config.re_retirement = args.re_retirement == "on"
+    # Re-validate with the overrides applied (the spill/streamed-RE
+    # cross-field rules must hold for the effective config).
+    config.validate()
     return run(config)
 
 

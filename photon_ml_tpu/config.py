@@ -219,6 +219,25 @@ class TrainingConfig:
     spill_dir: str | None = None
     host_max_resident: int = 2
     prefetch_depth: int = 2
+    # Out-of-core random-effect training (game/coordinates.py
+    # StreamedRandomEffectCoordinate, ISSUE 5): when set, every
+    # random-effect coordinate's entity blocks are split into
+    # fixed-shape chunks of re_chunk_entities entities per size bucket,
+    # spilled through the chunk store (same spill_dir /
+    # host_max_resident window / prefetch_depth pipeline as chunked
+    # fixed effects), and solved chunk-by-chunk by the vmapped masked
+    # while_loop — HBM/host residency is bounded by the window instead
+    # of the entity count.  Requires spill_dir (or
+    # $PHOTON_ML_TPU_SPILL_DIR).  With a mesh (n_devices) the chunk
+    # size rounds up to the device grid and every chunk entity-shards.
+    re_chunk_entities: int | None = None
+    # Converged-entity retirement (streamed REs only): between CD
+    # sweeps, entities whose coefficients AND offsets moved less than
+    # the solver tolerance are frozen (scores stay folded into totals)
+    # and later sweeps solve only the active set; a retired entity
+    # wakes if its offsets drift past the tolerance, so the final model
+    # stays within solver tolerance of the retirement-off fit.
+    re_retirement: bool = True
     # Warm-path artifact caches (photon_ml_tpu.cache): plan_cache_dir
     # persists compiled GRR plans keyed by dataset fingerprint ×
     # plan-config × planner version, so the second run of a workload
@@ -278,10 +297,22 @@ class TrainingConfig:
             raise ValueError("host_max_resident must be >= 1")
         if self.prefetch_depth < 0:
             raise ValueError("prefetch_depth must be >= 0")
-        if self.spill_dir is not None and self.chunk_rows is None:
+        if (self.spill_dir is not None and self.chunk_rows is None
+                and self.re_chunk_entities is None):
             raise ValueError(
-                "spill_dir requires chunked training (chunk_rows): "
-                "only chunk batches spill to the disk tier")
+                "spill_dir requires chunked training (chunk_rows) or "
+                "streamed random effects (re_chunk_entities): only "
+                "chunk batches spill to the disk tier")
+        if self.re_chunk_entities is not None:
+            if self.re_chunk_entities <= 0:
+                raise ValueError("re_chunk_entities must be positive")
+            from photon_ml_tpu.data.chunk_store import resolve_spill_dir
+
+            if resolve_spill_dir(self.spill_dir) is None:
+                raise ValueError(
+                    "re_chunk_entities requires spill_dir (or "
+                    "$PHOTON_ML_TPU_SPILL_DIR): streamed random-effect "
+                    "training is store-backed")
         if self.chunk_rows is not None:
             if self.chunk_rows <= 0:
                 raise ValueError("chunk_rows must be positive")

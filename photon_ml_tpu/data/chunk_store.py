@@ -173,6 +173,59 @@ def decode_array_chunk(meta: dict, arrays) -> dict:
     return {k: arrays[k] for k in meta["keys"]}
 
 
+# Entity-block chunk leaves (streamed random effects, ISSUE 5): a chunk
+# is ``re_chunk_entities`` padded entity problems of one size bucket —
+# x [C, cap, p] plus [C, cap] scalar planes.  Offsets are (as ever)
+# absent: they are CD-iteration state, scattered in at load time from
+# the coordinate's resident per-example maps.
+_ENTITY_LEAF_FIELDS = ("x", "labels", "weights", "mask")
+
+
+def encode_entity_chunk(chunk: dict) -> tuple[dict, dict]:
+    """Entity-block chunk (name → ndarray with the ``x``/``labels``/
+    ``weights``/``mask`` leaves) → (manifest, arrays).  The random-
+    effect streaming codec: same spill/mmap/LRU machinery as the
+    training/scoring codecs, keyed leaves so a decode can never bind a
+    plane to the wrong role."""
+    arrays = {f: np.asarray(chunk[f]) for f in _ENTITY_LEAF_FIELDS}
+    meta = {"version": CHUNK_FORMAT_VERSION, "kind": "entity_blocks"}
+    return meta, arrays
+
+
+def decode_entity_chunk(meta: dict, arrays) -> dict:
+    """Inverse of ``encode_entity_chunk``; memmap views pass through
+    (entity blocks stay file-backed in the host window)."""
+    if meta.get("version") != CHUNK_FORMAT_VERSION:
+        raise ValueError(f"chunk format {meta.get('version')!r} != "
+                         f"{CHUNK_FORMAT_VERSION}")
+    if meta.get("kind") != "entity_blocks":
+        raise ValueError(
+            f"chunk kind {meta.get('kind')!r} != 'entity_blocks'")
+    return {f: arrays[f] for f in _ENTITY_LEAF_FIELDS}
+
+
+ENTITY_CHUNK_CODEC = (encode_entity_chunk, decode_entity_chunk)
+
+
+def array_content_key(arrays, cfg: dict) -> str:
+    """Content fingerprint for chunk payloads derived from plain host
+    arrays (the streamed-RE analog of ``store_key``): exact input
+    bytes × build configuration; the format version rides in the file
+    name as everywhere else.  ``arrays`` is an iterable of ndarrays
+    hashed with dtype/shape framing so transposed or reshaped inputs
+    cannot collide."""
+    h = hashlib.blake2b(digest_size=10)
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.view(np.uint8).reshape(-1))
+    cfg_h = hashlib.blake2b(
+        json.dumps(cfg, sort_keys=True).encode(),
+        digest_size=6).hexdigest()
+    return f"{h.hexdigest()}-{cfg_h}"
+
+
 def decode_chunk(meta: dict, arrays):
     """Inverse of ``encode_chunk``; ``arrays`` may be lazy (memmap
     views or an open NpzFile).  Offsets come back ZERO — the caller

@@ -351,8 +351,8 @@ class GameEstimator:
         of new vs saved entity ids, then per-(new bucket, old bucket)
         block gathers — the bucket grid is O(log² max-count), each cell
         one fancy-indexed copy."""
-        w0s = [np.zeros((blk.shape[0], blk.shape[-1]), np.float32)
-               for blk in coord.x_blocks]
+        w0s = [np.zeros(shape, np.float32)
+               for shape in coord.coefficient_shapes]
         g = coord.grouping
         gs = comp.grouping
         if g.n_total_entities == 0 or gs.n_total_entities == 0:
@@ -498,7 +498,39 @@ class GameEstimator:
                     norm=NormalizationContext.identity(),
                 )
                 e_mesh = self._entity_mesh()
-                if isinstance(feats, np.ndarray):
+                if cfg.re_chunk_entities is not None:
+                    # Out-of-core streamed RE training (ISSUE 5): the
+                    # builder handles dense and sparse shards; env
+                    # default for spill_dir applies at THIS layer only
+                    # (library builders stay explicit — same rule as
+                    # the chunked fixed-effect path).
+                    from photon_ml_tpu.data.chunk_store import (
+                        resolve_spill_dir,
+                    )
+                    from photon_ml_tpu.game.coordinates import (
+                        build_streamed_random_effect_coordinate,
+                    )
+
+                    spill = resolve_spill_dir(cfg.spill_dir)
+                    if spill is None:
+                        raise ValueError(
+                            "re_chunk_entities requires spill_dir (or "
+                            "$PHOTON_ML_TPU_SPILL_DIR)")
+                    coords[coord_cfg.name] = (
+                        build_streamed_random_effect_coordinate(
+                            coord_cfg.entity_key, train,
+                            coord_cfg.feature_shard, objective,
+                            spill_dir=spill,
+                            chunk_entities=cfg.re_chunk_entities,
+                            config=ocfg,
+                            optimizer=coord_cfg.optimizer.optimizer,
+                            host_max_resident=cfg.host_max_resident,
+                            prefetch_depth=cfg.prefetch_depth,
+                            retirement=cfg.re_retirement,
+                            mesh=e_mesh,
+                        )
+                    )
+                elif isinstance(feats, np.ndarray):
                     coords[coord_cfg.name] = build_random_effect_coordinate(
                         coord_cfg.entity_key, train, coord_cfg.feature_shard,
                         objective, config=ocfg,

@@ -12,8 +12,10 @@ from photon_ml_tpu.game.coordinates import (
     Coordinate,
     FixedEffectCoordinate,
     RandomEffectCoordinate,
+    StreamedRandomEffectCoordinate,
     build_random_effect_coordinate,
     build_random_effect_coordinate_sparse,
+    build_streamed_random_effect_coordinate,
 )
 from photon_ml_tpu.game.projector import (
     SubspaceProjection,
@@ -37,8 +39,10 @@ __all__ = [
     "Coordinate",
     "FixedEffectCoordinate",
     "RandomEffectCoordinate",
+    "StreamedRandomEffectCoordinate",
     "build_random_effect_coordinate",
     "build_random_effect_coordinate_sparse",
+    "build_streamed_random_effect_coordinate",
     "SubspaceProjection",
     "build_subspace_projection",
     "binary_classification_down_sample",

@@ -27,6 +27,7 @@ import dataclasses
 import logging
 import time
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -35,10 +36,25 @@ from photon_ml_tpu.game.coordinates import Coordinate
 logger = logging.getLogger(__name__)
 
 
+@jax.jit
+def _re_diag_reduce(diag):
+    """Batched-RE convergence aggregation as ONE device program: the
+    per-bucket Python loop of ``jnp.sum``/``jnp.max`` calls performed
+    one blocking host sync per bucket per stat (ISSUE 5 satellite);
+    this folds every bucket's reduction into a single dispatch whose
+    result is fetched with one bulk device→host copy per sweep."""
+    conv = sum(jnp.sum(r.converged.astype(jnp.int32)) for r in diag)
+    iters = jnp.max(jnp.stack([jnp.max(r.iterations) for r in diag]))
+    return conv, iters
+
+
 def _diag_fields(diag) -> dict:
     """Scalar convergence fields from a coordinate's train diagnostics
     (an ``OptimizationResult`` for fixed effects; a per-bucket list of
-    batched results for random effects)."""
+    batched results for random effects; a plain dict — already host
+    scalars — for the streamed random-effect coordinate)."""
+    if isinstance(diag, dict):
+        return dict(diag)
     if hasattr(diag, "value") and jnp.ndim(diag.value) == 0:
         out = {
             "value": float(diag.value),
@@ -61,12 +77,12 @@ def _diag_fields(diag) -> dict:
             }
         return out
     if isinstance(diag, (list, tuple)) and diag and hasattr(diag[0], "value"):
-        # Batched per-entity results: aggregate convergence stats.
+        # Batched per-entity results: one jitted reduction, one bulk
+        # device→host copy (not one sync per bucket per stat).
         n = sum(int(r.value.shape[0]) for r in diag)
-        conv = sum(int(jnp.sum(r.converged)) for r in diag)
-        iters = max(int(jnp.max(r.iterations)) for r in diag)
-        return {"entities": n, "entities_converged": conv,
-                "max_solver_iterations": iters}
+        conv, iters = jax.device_get(_re_diag_reduce(list(diag)))
+        return {"entities": n, "entities_converged": int(conv),
+                "max_solver_iterations": int(iters)}
     return {}
 
 
@@ -233,6 +249,16 @@ def run_coordinate_descent(
             coefs[name] = w
             iter_diag[name] = diag
             elapsed = time.perf_counter() - t0
+            # Retirement hook (streamed random effects, ISSUE 5): the
+            # coordinate stashed this sweep's converged-entity
+            # candidates during train; committing them HERE — after the
+            # scores are folded into the totals — freezes their
+            # coefficients so the next sweep re-packs only the active
+            # entities into chunks.  Part of the Coordinate contract:
+            # the base returns None (no retirement protocol).
+            newly_retired = coord.retire_converged()
+            extra = ({} if newly_retired is None
+                     else {"entities_newly_retired": newly_retired})
             logger.info(
                 "CD iter %d coordinate %s trained in %.2fs",
                 it + 1, name, elapsed,
@@ -241,6 +267,7 @@ def run_coordinate_descent(
                 run_logger.event(
                     "cd_coordinate", iteration=it + 1, coordinate=name,
                     duration_s=round(elapsed, 4), **_diag_fields(diag),
+                    **extra,
                 )
         history.append(iter_diag)
         if validator is not None:

@@ -23,6 +23,12 @@ execution model flips:
   (``EntityGrouping``); per-CD-iteration offsets move between example
   space and block space by static-index gather/scatter on device.
 
+- ``StreamedRandomEffectCoordinate`` (round 10): the same vmapped
+  per-bucket solve driven chunk-by-chunk through the out-of-core chunk
+  store + prefetch pipeline, with converged-entity retirement between
+  CD sweeps — entity count bounded by DISK and the host window, not by
+  residency (see the class docstring).
+
 Scores are raw dot products x·w (no offset, no link), summable across
 coordinates — the reference's ``CoordinateDataScores`` convention.
 """
@@ -30,6 +36,7 @@ coordinates — the reference's ``CoordinateDataScores`` convention.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from functools import partial
 
 import jax
@@ -49,6 +56,8 @@ from photon_ml_tpu.optim import OptimizationProblem, OptimizerConfig
 from photon_ml_tpu.optim.lbfgs import lbfgs_solve
 from photon_ml_tpu.optim.tron import tron_solve
 from photon_ml_tpu.parallel.distributed_objective import DistributedGLMObjective
+
+logger = logging.getLogger(__name__)
 
 Array = jax.Array
 
@@ -239,6 +248,82 @@ _re_train, _re_train_donating = _jit_solve(
     _re_train_impl, donate_argnums=(6,))  # w0s blocks
 
 
+# -- streamed-RE per-chunk programs (ISSUE 5) -------------------------------
+# One compiled program per (bucket shape, optimizer config): every entity
+# chunk of a bucket is congruent [C, cap_b, p_b], so the vmapped masked
+# while_loop solve replays one executable chunk after chunk, exactly as
+# the fixed-effect streaming tier replays its per-chunk objective.
+
+
+def _re_chunk_train_impl(optimizer, config, has_l1, objective, x, labels,
+                         weights, mask, offsets, w0):
+    problem = OptimizationProblem(
+        objective=objective, optimizer=optimizer, config=config
+    )
+    batch = DenseBatch(x=x, labels=labels, weights=weights,
+                       offsets=offsets, mask=mask)
+    res = jax.vmap(partial(problem.run, has_l1=has_l1))(batch, w0)
+    # Scores and per-entity movement come out of the SAME dispatch: the
+    # chunk is already in device memory, so the CD sweep never pays a
+    # second scoring pass over the store.
+    scores = jnp.einsum("ecp,ep->ec", x, res.w)
+    dw = jnp.max(jnp.abs(res.w - w0), axis=-1)
+    return res.w, scores, dw, res.converged, res.iterations
+
+
+_re_chunk_train = jax.jit(_re_chunk_train_impl, static_argnums=(0, 1, 2))
+
+
+@jax.jit
+def _re_chunk_score(x, w):
+    return jnp.einsum("ecp,ep->ec", x, w)
+
+
+@jax.jit
+def _re_chunk_vars(objective, x, labels, weights, mask, offsets, w):
+    from photon_ml_tpu.optim.variance import simple_variances
+
+    batch = DenseBatch(x=x, labels=labels, weights=weights,
+                       offsets=offsets, mask=mask)
+    return jax.vmap(
+        lambda w_, b_: simple_variances(objective, w_, b_)
+    )(w, batch)
+
+
+def _entity_example_runs(ex_sorted_b: np.ndarray, starts_b: np.ndarray,
+                         ents: np.ndarray):
+    """Vectorized (example ids, chunk rows, within-entity cols) for the
+    entities ``ents`` (global bucket slots) — the index maps that move
+    per-example offsets into block space and block scores back out.
+    ``ex_sorted_b`` orders the bucket's examples by (entity slot,
+    within-entity position), so each entity is one contiguous run and
+    any packed chunk's map is O(examples) numpy arithmetic."""
+    counts = (starts_b[ents + 1] - starts_b[ents]).astype(np.int64)
+    total = int(counts.sum())
+    rows = np.repeat(np.arange(len(ents), dtype=np.int64), counts)
+    cum = np.cumsum(counts) - counts
+    cols = np.arange(total, dtype=np.int64) - np.repeat(cum, counts)
+    idx = np.repeat(starts_b[ents], counts) + cols
+    return ex_sorted_b[idx], rows, cols
+
+
+def _example_runs(grouping: EntityGrouping):
+    """Per-bucket (ex_sorted, ent_starts) run maps (see
+    ``_entity_example_runs``)."""
+    ex_sorted, ent_starts = [], []
+    for b, ne in enumerate(grouping.n_entities):
+        sel = np.flatnonzero(grouping.example_bucket == b)
+        order = np.lexsort((grouping.example_col[sel],
+                            grouping.example_row[sel]))
+        sel = sel[order].astype(np.int64)
+        ex_sorted.append(sel)
+        counts = np.bincount(grouping.example_row[sel], minlength=ne)
+        starts = np.zeros(ne + 1, np.int64)
+        np.cumsum(counts, out=starts[1:])
+        ent_starts.append(starts)
+    return ex_sorted, ent_starts
+
+
 @partial(jax.jit, static_argnums=0)
 def _re_score(n_examples: int, x_blocks, ex_idx, row_idx, col_idx,
               coefficient_blocks) -> Array:
@@ -279,6 +364,14 @@ class Coordinate:
     def score(self, coefficients) -> Array:
         """coefficients → per-example scores [n]."""
         raise NotImplementedError
+
+    def retire_converged(self) -> int | None:
+        """Commit this sweep's converged-entity retirement candidates
+        (the coordinate-descent between-sweeps hook).  Base contract:
+        no retirement protocol — returns None; the streamed
+        random-effect coordinate overrides with the number of newly
+        frozen entities."""
+        return None
 
 
 @dataclasses.dataclass(eq=False)
@@ -612,6 +705,420 @@ class RandomEffectCoordinate(Coordinate):
         return _re_variances(self.problem.objective, self._blocks(),
                              coefficient_blocks, offsets)
 
+    @property
+    def coefficient_shapes(self) -> list[tuple[int, int]]:
+        """(entities, width) per bucket — the shape contract shared
+        with the streamed coordinate (warm-start import sizes its
+        zero blocks from this, not from resident x_blocks)."""
+        return [(blk.shape[0], blk.shape[-1]) for blk in self.x_blocks]
+
+
+@dataclasses.dataclass(eq=False)
+class StreamedRandomEffectCoordinate(Coordinate):
+    """Out-of-core random-effect training: streamed entity-bucket
+    solves with converged-entity retirement (ISSUE 5 tentpole).
+
+    The resident ``RandomEffectCoordinate`` holds every bucket's
+    ``[E_b, cap_b, p_b]`` entity blocks in device/host memory for the
+    whole descent — the last subsystem still capped at the resident
+    class.  Here each bucket's entities are split into fixed-shape
+    *entity chunks* (``chunk_entities`` per chunk, last chunk padded
+    with zero-mask entities), spilled through ``data.chunk_store``
+    (entity-block codec; content-keyed, mmap-loaded, LRU
+    ``host_max_resident`` window, lineage rebuild, warm across runs)
+    and driven chunk-by-chunk through the round-8 prefetch pipeline
+    (``optim.streaming.prefetch_stream``: disk read → host staging →
+    async device_put under the previous chunk's solve).  Only the
+    coefficient blocks ``[E_b, p_b]``, the per-example run maps, and
+    the score plane stay resident, so host/HBM footprint is bounded by
+    the window instead of E.
+
+    **Converged-entity retirement**: between CD sweeps
+    (``retire_converged``, called by the coordinate-descent loop),
+    entities whose coefficients AND offsets moved less than the solver
+    tolerance are retired into a frozen set — their scores stay folded
+    into the totals (x and w are unchanged, so the cached scores are
+    exact) while subsequent sweeps re-pack only the ACTIVE entities
+    into chunks.  Per-sweep solve work shrinks as the descent
+    converges, and one hard entity no longer keeps thousands of
+    converged lanes spinning through the masked while_loop.  Retired
+    entities wake up if their offsets later drift by more than the
+    tolerance, so retirement can never move the final model beyond
+    solver tolerance.
+    """
+
+    name: str
+    grouping: EntityGrouping
+    problem: OptimizationProblem
+    store: "object"                  # data.chunk_store.ChunkStore
+    # Entities per chunk, PER BUCKET: the requested ``re_chunk_entities``
+    # balanced across each bucket's chunk count and capped by the
+    # bucket's entity count (a global chunk size would pad a small
+    # bucket's chunks with dead solve lanes — at cap 1024 that is real
+    # FLOPs and real bytes), then rounded up to the mesh grid.
+    chunk_ents: list[int]
+    widths: list[int]                # p_b per bucket
+    ex_sorted: list[np.ndarray]      # per bucket [n_b] example ids
+    ent_starts: list[np.ndarray]     # per bucket [E_b + 1] run starts
+    chunk_base: list[int]            # global chunk-id base per bucket
+    n_source_chunks: list[int]       # chunks per bucket
+    n_examples: int
+    mesh: "object | None" = None
+    prefetch_depth: int = 2
+    retirement: bool = True
+    # Coefficient/offset movement threshold for retirement; None =
+    # the solver tolerance (the ISSUE contract).
+    retire_tolerance: float | None = None
+    projection: "SubspaceProjection | None" = None
+
+    def __post_init__(self):
+        if self.retire_tolerance is None:
+            self.retire_tolerance = float(self.problem.config.tolerance)
+        ne = self.grouping.n_entities
+        self._w_host = [np.zeros((e, p), np.float32)
+                        for e, p in zip(ne, self.widths)]
+        self._active = [np.ones(e, bool) for e in ne]
+        self._pending = [np.zeros(e, bool) for e in ne]
+        self._scores_host = np.zeros(self.n_examples, np.float32)
+        self._solved_offsets: np.ndarray | None = None
+        self._prev_offsets: np.ndarray | None = None
+        # The blocks the last train() returned, held BY REFERENCE (an
+        # id()-only key could match a recycled address after GC and
+        # serve stale cached scores / skip warm-start adoption).
+        self._last_w_blocks: list | None = None
+        self._cached_scores: Array | None = None
+        self.last_diag: dict = {}
+
+    def _is_last_train_output(self, blocks) -> bool:
+        return (self._last_w_blocks is not None
+                and len(blocks) == len(self._last_w_blocks)
+                and all(a is b for a, b in zip(blocks,
+                                               self._last_w_blocks)))
+
+    # -- shape/contract surface -------------------------------------------
+
+    @property
+    def coefficient_shapes(self) -> list[tuple[int, int]]:
+        return [(w.shape[0], w.shape[1]) for w in self._w_host]
+
+    def initial_coefficients(self) -> list[Array]:
+        return [jnp.zeros((e, p), jnp.float32)
+                for e, p in zip(self.grouping.n_entities, self.widths)]
+
+    @property
+    def entities_retired(self) -> int:
+        return int(sum((~a).sum() for a in self._active))
+
+    # -- index/run helpers --------------------------------------------------
+
+    def _entity_max(self, b: int, per_example: np.ndarray) -> np.ndarray:
+        """Per-entity max of a per-example quantity over bucket b's
+        runs ([E_b]; one vectorized reduceat, no Python per entity)."""
+        v = per_example[self.ex_sorted[b]]
+        return np.maximum.reduceat(v, self.ent_starts[b][:-1])
+
+    @property
+    def chunk_entities(self) -> int:
+        """Largest per-bucket chunk size (display/diagnostics)."""
+        return max(self.chunk_ents) if self.chunk_ents else 0
+
+    def _specs(self) -> list[tuple[int, np.ndarray]]:
+        """Packed chunk plan for this sweep: active entities of each
+        bucket, ascending slot order, ``chunk_ents[b]`` per chunk —
+        ascending slots keep source-chunk access sequential, so the
+        LRU window streams forward exactly like a fixed-effect sweep."""
+        specs = []
+        for b, act in enumerate(self._active):
+            C = self.chunk_ents[b]
+            sel = np.flatnonzero(act)
+            for lo in range(0, len(sel), C):
+                specs.append((b, sel[lo:lo + C]))
+        return specs
+
+    def _assemble(self, spec, offsets: np.ndarray, with_w0: bool = True,
+                  x_only: bool = False):
+        """Load stage (runs on the prefetch thread): pull the source
+        chunk(s) from the store window, gather the active entities'
+        rows into one fixed-shape packed chunk, scatter the CURRENT
+        offsets into block space, and gather the warm-start lanes from
+        the resident coefficients.  A full, untouched source chunk
+        passes its (possibly memmap) arrays straight through — the
+        all-active steady state costs no host copy.  ``x_only`` skips
+        the scalar planes and the offsets scatter for consumers that
+        read nothing but ``x`` (the foreign-blocks scoring pass)."""
+        b, ents = spec
+        C = self.chunk_ents[b]
+        cap = self.grouping.capacities[b]
+        p = self.widths[b]
+        base = self.chunk_base[b]
+        src = ents // C
+        full = (len(ents) == C and src[0] == src[-1]
+                and int(ents[0]) == int(src[0]) * C
+                and int(ents[-1]) == int(src[0]) * C + C - 1)
+        if full:
+            ch = self.store.get(base + int(src[0]))
+            x = ch["x"]
+            if not x_only:
+                lab, wt, mk = ch["labels"], ch["weights"], ch["mask"]
+        else:
+            x = np.zeros((C, cap, p), np.float32)
+            if not x_only:
+                lab = np.zeros((C, cap), np.float32)
+                wt = np.zeros((C, cap), np.float32)
+                mk = np.zeros((C, cap), np.float32)
+            for s in np.unique(src):          # ascending: LRU-friendly
+                m = src == s
+                ch = self.store.get(base + int(s))
+                rows_local = (ents[m] - int(s) * C).astype(np.int64)
+                dst = np.flatnonzero(m)
+                x[dst] = ch["x"][rows_local]
+                if not x_only:
+                    lab[dst] = ch["labels"][rows_local]
+                    wt[dst] = ch["weights"][rows_local]
+                    mk[dst] = ch["mask"][rows_local]
+        ex, rows, cols = _entity_example_runs(
+            self.ex_sorted[b], self.ent_starts[b], ents)
+        if x_only:
+            arrays = {"x": x}
+        else:
+            off = np.zeros((C, cap), np.float32)
+            off[rows, cols] = offsets[ex]
+            arrays = {"x": x, "labels": lab, "weights": wt, "mask": mk,
+                      "offsets": off}
+        if with_w0:
+            w0 = np.zeros((C, p), np.float32)
+            w0[: len(ents)] = self._w_host[b][ents]
+            arrays["w0"] = w0
+        return {"arrays": arrays, "b": b, "ents": ents, "ex": ex,
+                "rows": rows, "cols": cols}
+
+    def _place(self, item):
+        """Device placement stage: async device_put (entity-sharded on
+        the mesh); the host index maps ride alongside for the
+        consumer's score scatter."""
+        from photon_ml_tpu.parallel.mesh import place_entity_chunk
+
+        dev = place_entity_chunk(item["arrays"], self.mesh)
+        return (dev, item["b"], item["ents"], item["ex"], item["rows"],
+                item["cols"])
+
+    def _stream(self, specs, offsets: np.ndarray, with_w0: bool = True,
+                x_only: bool = False):
+        from photon_ml_tpu.optim.streaming import prefetch_stream
+
+        load = lambda j: self._assemble(specs[j], offsets, with_w0,
+                                        x_only)
+        return prefetch_stream(load, self._place, range(len(specs)),
+                               self.prefetch_depth, store=self.store)
+
+    # -- train ---------------------------------------------------------------
+
+    def _adopt_warm_start(self, warm_start) -> None:
+        """External warm-start coefficients (saved model import,
+        checkpoint resume): overwrite the resident blocks and reset the
+        retirement state — the movement bookkeeping the retirement
+        decision rests on is no longer about these coefficients."""
+        for b, w in enumerate(warm_start):
+            wb = np.asarray(w, np.float32)
+            if wb.shape != self._w_host[b].shape:
+                raise ValueError(
+                    f"warm-start bucket {b} shape {wb.shape} != "
+                    f"{self._w_host[b].shape}")
+            self._w_host[b] = wb.copy()
+        for b in range(len(self._active)):
+            self._active[b][:] = True
+            self._pending[b][:] = False
+        self._solved_offsets = None
+        self._prev_offsets = None
+
+    def train(self, offsets: Array, warm_start=None,
+              donate_warm_start: bool = False):
+        """One streamed sweep over the ACTIVE entities.  Scores come
+        out of the same per-chunk dispatch as the solve (no second
+        store pass); ``donate_warm_start`` is accepted for contract
+        parity and ignored (training state is host-resident)."""
+        del donate_warm_start
+        off = np.asarray(offsets, np.float32)
+        if off.shape[0] != self.n_examples:
+            raise ValueError(f"offsets length {off.shape[0]} != "
+                             f"n {self.n_examples}")
+        if warm_start is not None and not self._is_last_train_output(
+                list(warm_start)):
+            self._adopt_warm_start(warm_start)
+        rtol = self.retire_tolerance
+        if self._solved_offsets is None:
+            self._solved_offsets = off.copy()
+        elif self.retirement and self.entities_retired:
+            # Wake retired entities whose offsets drifted past the
+            # tolerance since their last solve — retirement must never
+            # move the final model beyond solver tolerance.  (Skipped
+            # while nothing is retired: the drift scan is O(n) per
+            # bucket.)
+            drift = np.abs(off - self._solved_offsets)
+            for b in range(len(self._active)):
+                woke = ((~self._active[b])
+                        & (self._entity_max(b, drift) >= rtol))
+                self._active[b] |= woke
+
+        specs = self._specs()
+        retired_now = self.entities_retired
+        ne = self.grouping.n_entities
+        solved = [np.zeros(e, bool) for e in ne]
+        conv = [np.zeros(e, bool) for e in ne]
+        dw = [np.zeros(e, np.float32) for e in ne]
+        max_iters = 0
+
+        def harvest(out, b, ents, ex, rows, cols):
+            nonlocal max_iters
+            k = len(ents)
+            w_np = np.asarray(out[0])[:k]
+            scores_np = np.asarray(out[1])
+            self._w_host[b][ents] = w_np
+            self._scores_host[ex] = scores_np[rows, cols]
+            dw[b][ents] = np.asarray(out[2])[:k]
+            solved[b][ents] = True
+            conv[b][ents] = np.asarray(out[3])[:k]
+            if k:
+                max_iters = max(max_iters,
+                                int(np.asarray(out[4])[:k].max()))
+            self._solved_offsets[ex] = off[ex]
+
+        opt = self.problem
+        has_l1 = opt.has_l1()
+        pending = None
+        for _, item in self._stream(specs, off):
+            dev, b, ents, ex, rows, cols = item
+            out = _re_chunk_train(
+                opt.optimizer, opt.config, has_l1, opt.objective,
+                dev["x"], dev["labels"], dev["weights"], dev["mask"],
+                dev["offsets"], dev["w0"],
+            )
+            if pending is not None:
+                # Lag-1 harvest IS the dispatch backpressure: fetching
+                # chunk j-1's blocks fences its solve while chunk j
+                # computes and chunks j+1.. prefetch — at most two
+                # chunks' device buffers are ever in flight.
+                harvest(*pending)
+            pending = (out, b, ents, ex, rows, cols)
+        if pending is not None:
+            harvest(*pending)
+
+        # Retirement candidates: solved, lane-converged, coefficients
+        # AND offsets both moved < tolerance this sweep.  Committed by
+        # the CD loop's retire_converged() hook, so direct train()
+        # callers (parity tests, notebooks) see pure streaming.
+        if self.retirement and self._prev_offsets is not None:
+            drift_prev = np.abs(off - self._prev_offsets)
+            for b in range(len(self._pending)):
+                doff = self._entity_max(b, drift_prev)
+                self._pending[b] = (solved[b] & conv[b]
+                                    & (dw[b] < rtol) & (doff < rtol))
+        self._prev_offsets = off.copy()
+
+        # The sweep churned one staging chunk's arrays per packed chunk;
+        # glibc retains much of that as arena slack, which would read as
+        # permanent RSS — the exact number an out-of-core path exists to
+        # bound.  Once per sweep, return it (no-op off Linux).
+        from photon_ml_tpu.data.chunk_store import release_free_heap
+
+        release_free_heap()
+        blocks_out = [jnp.asarray(w) for w in self._w_host]
+        self._last_w_blocks = list(blocks_out)
+        self._cached_scores = jnp.asarray(self._scores_host)
+        n_solved = int(sum(m.sum() for m in solved))
+        diag = {
+            "entities": int(sum(ne)),
+            "entities_solved": n_solved,
+            "entities_converged": int(sum((m & c).sum()
+                                          for m, c in zip(solved, conv))),
+            "entities_retired": retired_now,
+            "max_solver_iterations": max_iters,
+            "chunks_streamed": len(specs),
+        }
+        self.last_diag = diag
+        return blocks_out, diag
+
+    def retire_converged(self) -> int:
+        """Commit this sweep's retirement candidates (the coordinate-
+        descent hook, called between sweeps).  Returns the number of
+        newly retired entities; a no-op (0) with retirement off."""
+        if not self.retirement:
+            return 0
+        newly = 0
+        for b in range(len(self._active)):
+            pend = self._pending[b] & self._active[b]
+            newly += int(pend.sum())
+            self._active[b] &= ~pend
+            self._pending[b][:] = False
+        return newly
+
+    # -- score / export / variances -----------------------------------------
+
+    def score(self, coefficient_blocks: list[Array]) -> Array:
+        """Raw x·w per example.  The blocks the last ``train`` returned
+        hit the cached plane (scores were computed inside the solve
+        dispatch); zero blocks short-circuit (the CD shape probe);
+        anything else streams one scoring pass over the store."""
+        if (self._cached_scores is not None
+                and self._is_last_train_output(list(coefficient_blocks))):
+            return self._cached_scores
+        if all(not bool(jnp.any(bk != 0)) for bk in coefficient_blocks):
+            return jnp.zeros((self.n_examples,), jnp.float32)
+        blocks = [np.asarray(bk, np.float32) for bk in coefficient_blocks]
+        scores = np.zeros(self.n_examples, np.float32)
+        zeros = np.zeros(0, np.float32)   # unused: x_only skips offsets
+        for j, item in self._stream(self._full_specs(), zeros,
+                                    with_w0=False, x_only=True):
+            dev, b, ents, ex, rows, cols = item
+            w_chunk = np.zeros((self.chunk_ents[b], self.widths[b]),
+                               np.float32)
+            w_chunk[: len(ents)] = blocks[b][ents]
+            blk = np.asarray(_re_chunk_score(dev["x"],
+                                             jnp.asarray(w_chunk)))
+            scores[ex] = blk[rows, cols]
+        return jnp.asarray(scores)
+
+    def _full_specs(self) -> list[tuple[int, np.ndarray]]:
+        specs = []
+        for b, e in enumerate(self.grouping.n_entities):
+            C = self.chunk_ents[b]
+            for s in range(self.n_source_chunks[b]):
+                lo = s * C
+                specs.append((b, np.arange(lo, min(lo + C, e),
+                                           dtype=np.int64)))
+        return specs
+
+    def as_model(self, coefficient_blocks: list[Array]) -> RandomEffectModel:
+        return RandomEffectModel(
+            coefficient_blocks=coefficient_blocks,
+            grouping=self.grouping,
+            feature_shard=self.name,
+            projection=self.projection,
+        )
+
+    def compute_variance_blocks(
+        self, coefficient_blocks: list[Array], offsets: Array
+    ) -> list[Array]:
+        """SIMPLE per-entity variances, streamed chunk-by-chunk (one
+        more full pass over the store — variances are a once-per-fit
+        export, not sweep state)."""
+        off = np.asarray(offsets, np.float32)
+        blocks = [np.asarray(bk, np.float32) for bk in coefficient_blocks]
+        out = [np.zeros((e, p), np.float32)
+               for e, p in zip(self.grouping.n_entities, self.widths)]
+        for j, item in self._stream(self._full_specs(), off,
+                                    with_w0=False):
+            dev, b, ents, ex, rows, cols = item
+            w_chunk = np.zeros((self.chunk_ents[b], self.widths[b]),
+                               np.float32)
+            w_chunk[: len(ents)] = blocks[b][ents]
+            v = np.asarray(_re_chunk_vars(
+                self.problem.objective, dev["x"], dev["labels"],
+                dev["weights"], dev["mask"], dev["offsets"],
+                jnp.asarray(w_chunk)))
+            out[b][ents] = v[: len(ents)]
+        return [jnp.asarray(v) for v in out]
+
 
 def _shard_re_blocks(coord_kwargs: dict, mesh) -> dict:
     """Entity-shard a coordinate's bucket blocks on the mesh
@@ -675,6 +1182,7 @@ def build_random_effect_coordinate(
         optimizer=optimizer or OptimizerType.LBFGS,
         config=config or OptimizerConfig(),
     )
+    _log_occupancy(name, grouping)
     return RandomEffectCoordinate(
         name=name,
         grouping=grouping,
@@ -688,6 +1196,24 @@ def build_random_effect_coordinate(
         n_examples=len(labels),
         problem=problem,
     )
+
+
+def _log_occupancy(name: str, grouping) -> None:
+    """One line of bucket occupancy / padding-waste stats per RE
+    coordinate build (ISSUE 5 satellite): a ``bucket_base`` regression
+    multiplies every block array silently — make it visible."""
+    from photon_ml_tpu.game.dataset import bucket_occupancy
+
+    occ = bucket_occupancy(grouping)
+    per_bucket = ", ".join(
+        f"cap={b['capacity']}:E={b['entities']}:fill={b['fill_fraction']}"
+        for b in occ["buckets"])
+    logger.info(
+        "RE coordinate '%s': %d entities / %d examples in %d buckets "
+        "[%s]; padded-slot ratio %.4f (%d of %d slots)",
+        name, occ["entities"], occ["examples"], len(occ["buckets"]),
+        per_bucket, occ["padded_slot_ratio"], occ["padded_slots"],
+        occ["total_slots"])
 
 
 def _scalar_blocks(grouping, labels, weights):
@@ -766,6 +1292,7 @@ def build_random_effect_coordinate_sparse(
     lab_blocks = blocks["label_blocks"]
     wt_blocks = blocks["weight_blocks"]
     mask_blocks = blocks["mask_blocks"]
+    _log_occupancy(name, grouping)
     return RandomEffectCoordinate(
         name=name,
         grouping=grouping,
@@ -778,5 +1305,191 @@ def build_random_effect_coordinate_sparse(
         col_idx=col_idx,
         n_examples=len(labels),
         problem=problem,
+        projection=projection,
+    )
+
+
+def build_streamed_random_effect_coordinate(
+    name: str,
+    dataset: GameDataset,
+    feature_shard: str,
+    objective: GLMObjective,
+    spill_dir: str,
+    chunk_entities: int,
+    config: OptimizerConfig | None = None,
+    optimizer=None,
+    bucket_base: int = 4,
+    host_max_resident: int = 2,
+    prefetch_depth: int = 2,
+    retirement: bool = True,
+    mesh=None,
+) -> StreamedRandomEffectCoordinate:
+    """Out-of-core variant of the RE coordinate builders: entity
+    blocks are built ONE CHUNK AT A TIME and spilled straight to the
+    chunk store (content-keyed; an existing file for the same data +
+    config is reused, so a second run's build is pure stat calls), so
+    peak host RSS during ETL is bounded by the chunk, not by E.
+
+    Dense feature shards assemble each chunk directly from the
+    per-example feature rows; sparse shards go through the subspace
+    projection (``game.projector``) first — the projection build is
+    inherently global (per-entity column sets), so its blocks are
+    materialized once, spilled, and freed, with lineage rebuild
+    re-running the (deterministic) projection on demand.
+
+    ``chunk_entities`` is rounded up to the mesh grid when ``mesh`` is
+    given: every packed chunk then entity-shards evenly
+    (``parallel.mesh.place_entity_chunk``).
+    """
+    from photon_ml_tpu.data.chunk_store import (
+        ENTITY_CHUNK_CODEC,
+        ChunkStore,
+        array_content_key,
+        release_free_heap,
+    )
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+    from photon_ml_tpu.optim.base import OptimizerType
+
+    if chunk_entities <= 0:
+        raise ValueError("chunk_entities must be positive")
+    if not spill_dir:
+        raise ValueError(
+            "streamed random-effect training requires spill_dir (the "
+            "chunk store is the architecture, not an option)")
+    feats = dataset.features[feature_shard]
+    entity_ids = np.asarray(dataset.entity_ids[name])
+    grouping = group_by_entity(entity_ids, bucket_base=bucket_base)
+    labels = dataset.labels.astype(np.float32)
+    weights = dataset.weight_array()
+    n_dev = 1 if mesh is None else mesh.devices.size
+    # Per-bucket chunk size: the requested budget, balanced across the
+    # bucket's chunk count and capped by its entity count — a GLOBAL
+    # chunk size would pad a small bucket's one chunk with dead solve
+    # lanes (at cap 1024 × p that is real FLOPs and real transfer) —
+    # then rounded up to the mesh grid.
+    chunk_ents = []
+    for e in grouping.n_entities:
+        k_b = max(1, -(-e // max(1, int(chunk_entities))))
+        cb = -(-e // k_b)
+        chunk_ents.append(-(-cb // n_dev) * n_dev)
+    ex_sorted, ent_starts = _example_runs(grouping)
+
+    sparse = not isinstance(feats, np.ndarray)
+    projection = None
+    if sparse:
+        from photon_ml_tpu.game.projector import build_subspace_projection
+
+        if not isinstance(feats, SparseRows):
+            feats = SparseRows.from_rows(feats)
+        global_dim = dataset.feature_dim(feature_shard)
+        projection, x_blocks_np = build_subspace_projection(
+            grouping, feats, global_dim)
+        widths = [xb.shape[-1] for xb in x_blocks_np]
+        # Blocks are freed after the spill below; lineage rebuild
+        # re-runs the (deterministic) projection on demand.
+        src_holder = {"blocks": x_blocks_np}
+
+        def chunk_x(b, lo, hi):
+            if src_holder["blocks"] is None:
+                src_holder["blocks"] = build_subspace_projection(
+                    grouping, feats, global_dim)[1]
+            return src_holder["blocks"][b][lo:hi]
+
+        key_arrays = [np.asarray(feats.indptr), np.asarray(feats.cols),
+                      np.asarray(feats.vals, np.float32), labels,
+                      weights, entity_ids]
+    else:
+        x = np.asarray(feats, np.float32)
+        widths = [x.shape[1]] * len(grouping.capacities)
+        src_holder = None
+        chunk_x = None
+        key_arrays = [x, labels, weights, entity_ids]
+
+    n_source_chunks = [-(-e // cb)
+                       for e, cb in zip(grouping.n_entities, chunk_ents)]
+    chunk_base = list(np.concatenate(
+        [[0], np.cumsum(n_source_chunks)[:-1]]).astype(int)) \
+        if n_source_chunks else []
+    total_chunks = int(sum(n_source_chunks))
+
+    def locate(gid: int) -> tuple[int, int]:
+        for b in range(len(chunk_base) - 1, -1, -1):
+            if gid >= chunk_base[b]:
+                return b, gid - chunk_base[b]
+        raise IndexError(gid)
+
+    def build_chunk(b: int, s: int) -> dict:
+        cap = grouping.capacities[b]
+        p = widths[b]
+        C = chunk_ents[b]
+        lo = s * C
+        hi = min(lo + C, grouping.n_entities[b])
+        ents = np.arange(lo, hi, dtype=np.int64)
+        ex, rows, cols = _entity_example_runs(
+            ex_sorted[b], ent_starts[b], ents)
+        lb = np.zeros((C, cap), np.float32)
+        wt = np.zeros((C, cap), np.float32)
+        mk = np.zeros((C, cap), np.float32)
+        lb[rows, cols] = labels[ex]
+        wt[rows, cols] = weights[ex]
+        mk[rows, cols] = 1.0
+        xc = np.zeros((C, cap, p), np.float32)
+        if sparse:
+            xc[: hi - lo] = chunk_x(b, lo, hi)
+        else:
+            xc[rows, cols] = x[ex]
+        return {"x": xc, "labels": lb, "weights": wt, "mask": mk}
+
+    def rebuild(gid: int) -> dict:
+        b, s = locate(gid)
+        return build_chunk(b, s)
+
+    key = array_content_key(key_arrays, {
+        "kind": "re-sparse" if sparse else "re-dense",
+        "chunk_ents": [int(cb) for cb in chunk_ents],
+        "bucket_base": int(bucket_base),
+        "widths": [int(p) for p in widths],
+    })
+    store = ChunkStore(spill_dir, key, total_chunks,
+                       host_max_resident=host_max_resident,
+                       rebuild=rebuild, codec=ENTITY_CHUNK_CODEC)
+    missing = [gid for gid in range(total_chunks) if not store.has(gid)]
+    for gid in missing:
+        b, s = locate(gid)
+        # Default admission (the first window's worth stays resident):
+        # the first sweep visits chunks in exactly this order, so it
+        # starts warm.
+        store.put(gid, build_chunk(b, s))
+    if sparse:
+        src_holder["blocks"] = None   # spilled; lineage rebuilds
+    if missing:
+        release_free_heap()   # build churn must not read as steady RSS
+
+    problem = OptimizationProblem(
+        objective=objective,
+        optimizer=optimizer or OptimizerType.LBFGS,
+        config=config or OptimizerConfig(),
+    )
+    _log_occupancy(name, grouping)
+    logger.info(
+        "streamed RE coordinate '%s': %d entity chunks (per-bucket "
+        "sizes %s; %d built, %d reused; host window %d) spilled to %s",
+        name, total_chunks, chunk_ents, len(missing),
+        total_chunks - len(missing), store.host_max_resident, spill_dir)
+    return StreamedRandomEffectCoordinate(
+        name=name,
+        grouping=grouping,
+        problem=problem,
+        store=store,
+        chunk_ents=[int(cb) for cb in chunk_ents],
+        widths=[int(p) for p in widths],
+        ex_sorted=ex_sorted,
+        ent_starts=ent_starts,
+        chunk_base=[int(cb) for cb in chunk_base],
+        n_source_chunks=[int(ks) for ks in n_source_chunks],
+        n_examples=len(labels),
+        mesh=mesh,
+        prefetch_depth=prefetch_depth,
+        retirement=retirement,
         projection=projection,
     )

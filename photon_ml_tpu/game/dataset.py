@@ -210,6 +210,50 @@ def group_by_entity(
     )
 
 
+def bucket_occupancy(grouping: EntityGrouping) -> dict:
+    """Per-bucket occupancy / padding-waste stats for one grouping.
+
+    The size-bucketing scheme bounds padding waste by ``bucket_base``×
+    by construction, but the ACTUAL waste depends on the entity-count
+    distribution — a regression in ``bucket_base`` (or a pathological
+    id distribution) silently multiplies every block array and every
+    vmapped solve lane.  Coordinate builders log this once per build so
+    the number is visible instead of silent (ISSUE 5 satellite).
+
+    Returns ``{"entities", "examples", "padded_slots", "total_slots",
+    "padded_slot_ratio", "buckets": [{"capacity", "entities",
+    "examples", "fill_fraction"}, ...]}``.
+    """
+    counts = np.asarray(grouping.entity_counts, np.int64)
+    bucket = np.asarray(grouping.entity_bucket)
+    n_buckets = len(grouping.capacities)
+    ex_per_bucket = np.bincount(bucket, weights=counts,
+                                minlength=n_buckets).astype(np.int64)
+    buckets = []
+    total_slots = 0
+    for b, (cap, ne) in enumerate(zip(grouping.capacities,
+                                      grouping.n_entities)):
+        slots = int(cap) * int(ne)
+        total_slots += slots
+        buckets.append({
+            "capacity": int(cap),
+            "entities": int(ne),
+            "examples": int(ex_per_bucket[b]),
+            "fill_fraction": (round(float(ex_per_bucket[b]) / slots, 4)
+                              if slots else 0.0),
+        })
+    n = int(grouping.n_examples)
+    return {
+        "entities": int(grouping.n_total_entities),
+        "examples": n,
+        "total_slots": total_slots,
+        "padded_slots": total_slots - n,
+        "padded_slot_ratio": (round((total_slots - n) / total_slots, 4)
+                              if total_slots else 0.0),
+        "buckets": buckets,
+    }
+
+
 def scatter_to_blocks(
     grouping: EntityGrouping, values: np.ndarray, fill: float = 0.0
 ) -> list[np.ndarray]:

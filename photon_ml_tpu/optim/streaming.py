@@ -193,6 +193,36 @@ class ChunkPrefetcher:
 _ChunkPrefetcher = ChunkPrefetcher
 
 
+def prefetch_stream(load, place, order, depth: int, store=None):
+    """Yield ``(i, placed)`` for every ``i`` in ``order`` through the
+    three-tier prefetch pipeline (disk read → host staging → async
+    device_put, ``depth`` chunks ahead), or synchronously when
+    ``depth <= 0`` — the one entry point for consumers that drive a
+    chunk sweep themselves instead of owning a ``ChunkedGLMObjective``
+    (the streamed random-effect coordinate's per-bucket solves, ISSUE
+    5).  The prefetcher is always closed (and the store reader
+    released) when the generator exits, including on error or early
+    ``break`` — quiescence is structural, not a caller obligation."""
+    order = list(order)
+    if depth <= 0:
+        if store is not None:
+            store.begin_read()
+        try:
+            for i in order:
+                yield i, place(load(i))
+        finally:
+            if store is not None:
+                store.end_read()
+        return
+    pf = ChunkPrefetcher(load, place, depth, store=store)
+    pf.start(order)
+    try:
+        for i in order:
+            yield i, pf.next(i)
+    finally:
+        pf.close()
+
+
 # ---------------------------------------------------------------------------
 # Per-chunk device programs, jitted at MODULE level so every
 # ChunkedGLMObjective instance shares one compile cache: λ-grid /

@@ -70,6 +70,28 @@ def shard_entity_blocks(blocks: list, mesh: Mesh) -> list:
     return out
 
 
+def place_entity_chunk(arrays: dict, mesh: Mesh | None) -> dict:
+    """Host entity-chunk leaves (name → [C, ...] ndarray) → device,
+    entity-axis sharded when a mesh is given — the streamed random-
+    effect coordinate's per-chunk placement (ISSUE 5).  ``C`` must be a
+    multiple of the mesh size (the streamed builder rounds
+    ``re_chunk_entities`` up), so every device holds an equal slice of
+    the chunk's vmapped solve lanes; padding entities carry zero mask
+    and converge immediately, exactly as in ``shard_entity_blocks``."""
+    if mesh is None:
+        return jax.device_put(arrays)
+    n_dev = mesh.devices.size
+    for k, a in arrays.items():
+        if a.shape[0] % n_dev != 0:
+            raise ValueError(
+                f"entity chunk leaf '{k}' has {a.shape[0]} entities, "
+                f"not divisible by mesh size {n_dev}; round the chunk "
+                "size up to the mesh grid")
+    sharding = NamedSharding(mesh, P(ENTITY_AXIS))
+    return {k: jax.device_put(np.ascontiguousarray(a), sharding)
+            for k, a in arrays.items()}
+
+
 def batch_spec() -> P:
     """PartitionSpec sharding the example axis (every Batch leaf has the
     example dimension leading)."""

@@ -146,6 +146,48 @@ def test_bench_score_section_contract(tmp_path):
     assert rec["peak_rss_mb"]["score"] > 0
 
 
+def test_bench_re_section_contract(tmp_path):
+    """`--section re` keeps the budget/JSON-last-line contract and
+    records the out-of-core random-effect measurement (ISSUE 5):
+    per-arm sweep times, rows/s and peak RSS (subprocess isolation),
+    the LRU window bound, streamed-vs-resident coefficient/score
+    parity, and the converged-entity retirement work-reduction curve
+    (per-sweep solved entities monotone non-increasing, with real
+    reduction by the last sweep on the converging schedule)."""
+    proc = _run_bench(tmp_path, "--section", "re",
+                      "--budget-s", "240", *_TINY)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert rec["section"] == "re"
+    assert rec.get("errors") is None
+    r = rec["re"]
+    # Entity chunks must dwarf the streamed arm's host window.
+    assert r["n_chunks"] >= 4 * r["host_max_resident"]
+    assert 1 <= r["streamed"]["peak_live_chunks"] <= r["host_max_resident"]
+    assert r["streamed"]["disk_loads"] > 0
+    for arm in ("streamed", "resident"):
+        assert r[arm]["sweep_s"] > 0
+        assert r[arm]["rows_per_sec"] > 0
+        assert r[arm]["peak_rss_mb"] > 0
+        assert len(r[arm]["sweep_s_all"]) == r["sweeps"]
+    # Retirement work-reduction: monotone non-increasing solved counts,
+    # strictly fewer by the end (entities froze), none retired at the
+    # resident arm (no retirement support there).
+    solved = r["streamed"]["entities_solved_per_sweep"]
+    assert all(a >= b for a, b in zip(solved, solved[1:]))
+    assert solved[-1] < solved[0]
+    retired = r["streamed"]["entities_retired_per_sweep"]
+    assert all(a <= b for a, b in zip(retired, retired[1:]))
+    assert retired[-1] > 0
+    assert r["retirement_work_fraction"] < 1.0
+    # Retirement must not move the model beyond solver tolerance.
+    assert r["coef_parity_max"] < 1e-2
+    assert r["score_parity_max"] < 1e-2
+    assert r["sweep_time_ratio"] is not None
+    assert rec["peak_rss_mb"]["re"] > 0
+
+
 def test_bench_zero_budget_still_emits_json(tmp_path):
     """A hopeless budget skips every section but the process still
     exits 0 with one parseable JSON line recording the skips."""
