@@ -64,6 +64,7 @@ import sys
 import tempfile
 import time
 import traceback
+from contextlib import ExitStack
 
 import numpy as np
 
@@ -261,6 +262,7 @@ class BenchContext:
         self.n, self.d, self.k = args.n, args.d, args.k
         self.cache_dir = args.cache_dir
         self.no_compile_cache = args.no_compile_cache
+        self.guards = args.guards
         self.deadline = time.time() + args.budget_s
         self.budget_s = args.budget_s
         self.record: dict = {}
@@ -802,7 +804,23 @@ def stream_arm_main(args) -> int:
     # can set both arms' high-water, masking the training-regime
     # difference the section exists to measure.
     g = None
-    with _RssSampler() as rss:
+    # --guards: the timed sweeps run under the runtime guard harness —
+    # the steady-state contract is ZERO compiles (everything compiled
+    # in the warmup above; a nonzero count means a per-sweep retrace)
+    # and no implicit host<->device transfers in the per-chunk dispatch
+    # loop (transfer_guard 'log': reported, not fatal — on the CPU
+    # backend the guard is structurally silent, host == device).
+    guard_stack = ExitStack()
+    compile_log = None
+    if args.guards:
+        from photon_ml_tpu.analysis.guards import (
+            count_compiles,
+            no_implicit_transfers,
+        )
+
+        compile_log = guard_stack.enter_context(count_compiles())
+        guard_stack.enter_context(no_implicit_transfers("log"))
+    with guard_stack, _RssSampler() as rss:
         for _ in range(STREAM_SWEEPS):
             # Fence every pass — the streaming solver syncs per
             # evaluation (the line search reads the value on host).
@@ -841,6 +859,14 @@ def stream_arm_main(args) -> int:
                           if anon is not None
                           and base_anon_mb is not None else None),
     }
+    if compile_log is not None:
+        rec["guards"] = {
+            # Steady-state sweeps must compile nothing; a retrace here
+            # is exactly the regression the budget tests pin.
+            "sweep_compiles": compile_log.count,
+            "sweep_compile_programs": sorted(set(compile_log.programs)),
+            "transfer_guard": "log",
+        }
     if arm == "spilled":
         store = cb.store
         rec.update({
@@ -879,7 +905,8 @@ def section_stream(ctx: BenchContext) -> None:
             [sys.executable, os.path.abspath(__file__),
              "--stream-arm", arm, "--n", str(ctx.n), "--d", str(ctx.d),
              "--k", str(ctx.k), "--cache-dir", ctx.cache_dir]
-            + (["--no-compile-cache"] if ctx.no_compile_cache else []),
+            + (["--no-compile-cache"] if ctx.no_compile_cache else [])
+            + (["--guards"] if ctx.guards else []),
             capture_output=True, text=True,
             timeout=max(60.0, ctx.remaining()),
         )
@@ -1452,6 +1479,14 @@ def main(argv: list[str] | None = None) -> int:
                         "path, so repeated driver runs hit warm")
     p.add_argument("--no-compile-cache", action="store_true",
                    help="do not enable the persistent XLA cache")
+    p.add_argument("--guards", action="store_true",
+                   help="run guard-instrumented sections (currently "
+                        "stream) under photon_ml_tpu.analysis.guards: "
+                        "compile counting over the timed sweeps "
+                        "(steady state must compile nothing) and "
+                        "jax.transfer_guard('log') over the per-chunk "
+                        "dispatch loop; results land in the section "
+                        "record under 'guards'")
     p.add_argument("--stream-arm", choices=("spilled", "resident"),
                    default=None,
                    help="internal: run ONE arm of the stream section "

@@ -1,0 +1,904 @@
+"""AST invariant checkers (photon-lint).
+
+Each rule encodes a performance/correctness contract an earlier round
+established by hand and a later tier could silently regress:
+
+- ``jit-in-function``: no ``jax.jit`` / ``partial(jax.jit, ...)``
+  constructed inside function bodies or loops.  A per-call jit wrapper
+  owns a fresh executable cache, so every call re-traces and recompiles
+  the identical program -- the exact recompile hazard PR 2 removed from
+  the lambda-grid loop by hoisting per-chunk programs to module level.
+  Jits must be module-level or memoized (an ``functools.lru_cache`` /
+  ``functools.cache`` enclosing function is exempt).
+- ``tracer-hygiene``: no ``np.*`` calls, ``float()``/``int()``/
+  ``bool()``/``.item()`` casts, or ``if``-branching applied to values
+  that flow from a jitted/vmapped function's array parameters
+  (``static_argnums`` excluded).  Any of these forces a trace-time
+  concretization error at best, a silent host round-trip at worst.
+- ``unlocked-shared-write``: classes that spawn ``threading.Thread`` /
+  ``ThreadPoolExecutor`` (or that own a lock) must mutate shared
+  attributes under their lock or communicate via ``queue.Queue`` /
+  ``threading.Event``.  Flags writes reachable from both the worker
+  and the caller that are not lexically under a ``with self.<lock>:``.
+- ``accumulator-dtype``: streaming metric/loss accumulators (classes
+  with the ``update``/``result`` protocol) must fold on host in
+  float64 -- accumulation expressions must not run through ``jnp``
+  (device f32 folds) or explicit float32 casts.
+- ``env-read``: no raw ``os.environ`` / ``os.getenv`` reads outside
+  ``config.py``'s sanctioned registry (``config.read_env``) -- scatter
+  env fallbacks are invisible configuration.
+- ``slow-unmarked``: tests whose recorded tier-1 duration exceeds the
+  threshold must carry ``@pytest.mark.slow`` so the tier-1 wall clock
+  stops creeping (durations recorded once in
+  ``tests/tier1_durations.json``; see PERF.md).
+
+Waivers: a violation line may carry an inline waiver comment
+
+    # photon-lint: disable=<rule>[,<rule>] (<reason>)
+
+The reason is mandatory -- a waiver without one is ignored (and
+reported), so every suppression documents why the contract does not
+apply at that site.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+
+# Test duration above which a test must be @pytest.mark.slow (seconds).
+# Pinned at 10 s at introduction: the 5-10 s band holds ~30 more cases
+# whose removal would take tier-1 below its seed pass-count floor;
+# ratchet the threshold down as the fast tier grows (ISSUE 6 audit —
+# the 15 functions over 10 s were marked, cutting ~266 s of tier-1
+# wall clock; measurements in tests/tier1_durations.json).
+SLOW_THRESHOLD_S = 10.0
+
+# Recorded tier-1 durations (max over parametrizations, seconds),
+# measured once per re-baseline -- see tests/tier1_durations.json.
+DURATIONS_FILE = os.path.join("tests", "tier1_durations.json")
+
+RULES = {
+    "jit-in-function": (
+        "jax.jit constructed inside a function body or loop "
+        "(per-call recompile hazard; hoist to module level or memoize)"
+    ),
+    "tracer-hygiene": (
+        "host-side numpy/cast/branch applied to a traced array value "
+        "inside a jitted/vmapped function"
+    ),
+    "unlocked-shared-write": (
+        "shared mutable attribute written without the owning lock in a "
+        "thread-spawning class"
+    ),
+    "accumulator-dtype": (
+        "streaming accumulator folds through jnp/float32 instead of "
+        "host float64"
+    ),
+    "env-read": (
+        "raw os.environ read outside config.py's sanctioned registry "
+        "(use photon_ml_tpu.config.read_env)"
+    ),
+    "slow-unmarked": (
+        "test measured slower than the threshold lacks "
+        "@pytest.mark.slow"
+    ),
+    "bad-waiver": (
+        "photon-lint waiver without a (reason) — every suppression "
+        "must say why the contract does not apply"
+    ),
+    "syntax-error": "file failed to parse",
+}
+
+_WAIVER_RE = re.compile(
+    r"#\s*photon-lint:\s*disable=([\w,-]+)\s*(?:\((.*?)\))?")
+
+
+def _comments(source: str):
+    """(lineno, text, comment_only) for every real COMMENT token.
+
+    ``comment_only`` is True when nothing but whitespace precedes the
+    comment on its line.  Tokenization errors (the caller has already
+    ast-parsed the file, so these are near-impossible) degrade to the
+    comments seen so far."""
+    out = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string,
+                            tok.line[: tok.start[1]].strip() == ""))
+    except (tokenize.TokenError, IndentationError):
+        pass
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+    def github(self) -> str:
+        return (f"::error file={self.path},line={self.line},"
+                f"title={self.rule}::{self.message}")
+
+
+# ---------------------------------------------------------------------------
+# Shared AST plumbing
+# ---------------------------------------------------------------------------
+
+
+def _parents(tree: ast.AST) -> dict:
+    par: dict = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            par[child] = node
+    return par
+
+
+def _ancestors(node: ast.AST, par: dict):
+    n = par.get(node)
+    while n is not None:
+        yield n
+        n = par.get(n)
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` attribute/name chain as a string, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    """``jax.jit(...)`` or ``[functools.]partial(jax.jit, ...)``."""
+    tgt = _dotted(call.func)
+    if tgt in ("jax.jit", "jax.pmap"):
+        return True
+    if tgt in ("partial", "functools.partial") and call.args:
+        return _dotted(call.args[0]) in ("jax.jit", "jax.pmap")
+    return False
+
+
+def _static_argnums(call: ast.Call) -> tuple[set[int], set[str]]:
+    """Literal static_argnums / static_argnames from a jit call."""
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    nums.add(e.value)
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add(e.value)
+    return nums, names
+
+
+class _FileContext:
+    """One parsed source file + its waiver table."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.parents = _parents(self.tree)
+        self.waivers: dict[int, set[str]] = {}
+        self.bad_waivers: list[int] = []
+        lines = source.splitlines()
+        # Real COMMENT tokens only (tokenize): a waiver example quoted
+        # inside a docstring/string literal must neither suppress the
+        # next code line nor be reported as a bad waiver.
+        for lineno, text, comment_only in _comments(source):
+            m = _WAIVER_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = (m.group(2) or "").strip()
+            if not reason:
+                self.bad_waivers.append(lineno)
+                continue
+            self.waivers.setdefault(lineno, set()).update(rules)
+            # A waiver on a comment-only line covers the next code
+            # line (the inline form rarely fits the line limit).
+            if comment_only:
+                nxt = lineno + 1
+                while nxt <= len(lines) and (
+                        not lines[nxt - 1].strip()
+                        or lines[nxt - 1].strip().startswith("#")):
+                    nxt += 1
+                if nxt <= len(lines):
+                    self.waivers.setdefault(nxt, set()).update(rules)
+
+    def waived(self, line: int, rule: str) -> bool:
+        return rule in self.waivers.get(line, ())
+
+
+# ---------------------------------------------------------------------------
+# Rule: jit-in-function
+# ---------------------------------------------------------------------------
+
+
+_MEMO_DECORATORS = ("functools.lru_cache", "lru_cache", "functools.cache",
+                    "cache")
+
+
+def _is_memoized(fn: ast.AST) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        if _dotted(d) in _MEMO_DECORATORS:
+            return True
+    return False
+
+
+def check_jit_in_function(ctx: _FileContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node):
+            enclosing = None
+            in_loop = False
+            for anc in _ancestors(node, ctx.parents):
+                if isinstance(anc, (ast.For, ast.While)):
+                    in_loop = True
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                    enclosing = anc
+                    break
+            if enclosing is None and not in_loop:
+                continue
+            if enclosing is not None and _is_memoized(enclosing):
+                continue
+            # A decorator expression evaluates at def time, which for a
+            # module-level def is module scope -- exempt.
+            parent = ctx.parents.get(node)
+            if (isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node in parent.decorator_list
+                    and parent is enclosing):
+                continue
+            where = ("a loop" if enclosing is None
+                     else f"'{getattr(enclosing, 'name', '<lambda>')}'")
+            yield Violation(
+                ctx.path, node.lineno, "jit-in-function",
+                f"jax.jit constructed inside {where}: every call "
+                "re-traces and recompiles; hoist to module level or "
+                "memoize (functools.lru_cache)")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # @jax.jit on a def nested inside another function: the
+            # wrapper (and its compile cache) is rebuilt per outer call.
+            for anc in _ancestors(node, ctx.parents):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    if _is_memoized(anc):
+                        break
+                    for dec in node.decorator_list:
+                        # Bare @jax.jit (an Attribute — _dotted returns
+                        # None for Call nodes) or @partial(jax.jit, …).
+                        if _dotted(dec) in ("jax.jit", "jax.pmap") or (
+                                isinstance(dec, ast.Call)
+                                and _is_jit_call(dec)):
+                            yield Violation(
+                                ctx.path, node.lineno, "jit-in-function",
+                                f"@jax.jit on '{node.name}' nested "
+                                f"inside '{anc.name}': the wrapper is "
+                                "rebuilt (and recompiled) per outer "
+                                "call")
+                            break
+                    break
+
+
+# ---------------------------------------------------------------------------
+# Rule: tracer-hygiene
+# ---------------------------------------------------------------------------
+
+_NP_ALIASES = ("np", "numpy")
+_TRANSFORM_CALLS = ("jax.jit", "jax.vmap", "jax.pmap")
+
+
+def _jit_targets(ctx: _FileContext):
+    """(function node, static positions, static names) for every
+    function this file jits/vmaps: decorated defs, and module-level
+    ``name = jax.jit(fn_or_lambda, ...)`` assignments."""
+    defs: dict[str, ast.AST] = {}
+    lambdas: dict[str, ast.Lambda] = {}
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and isinstance(node.value,
+                                                      ast.Lambda):
+                lambdas[t.id] = node.value
+
+    seen: set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                tgt = _dotted(dec if not isinstance(dec, ast.Call)
+                              else dec.func)
+                if tgt in _TRANSFORM_CALLS:
+                    if id(node) not in seen:
+                        seen.add(id(node))
+                        yield node, set(), set()
+                elif isinstance(dec, ast.Call) and _is_jit_call(dec):
+                    nums, names = _static_argnums(dec)
+                    if id(node) not in seen:
+                        seen.add(id(node))
+                        yield node, nums, names
+        elif isinstance(node, ast.Call) and (
+                _dotted(node.func) in _TRANSFORM_CALLS) and node.args:
+            fn = node.args[0]
+            nums, names = _static_argnums(node)
+            target = None
+            if isinstance(fn, ast.Lambda):
+                target = fn
+            elif isinstance(fn, ast.Name):
+                target = defs.get(fn.id) or lambdas.get(fn.id)
+            if target is not None and id(target) not in seen:
+                seen.add(id(target))
+                yield target, nums, names
+
+
+def _tainted_params(fn, static_nums: set[int],
+                    static_names: set[str]) -> set[str]:
+    a = fn.args
+    ordered = list(a.posonlyargs) + list(a.args)
+    tainted = set()
+    for i, p in enumerate(ordered):
+        if i in static_nums or p.arg in static_names or p.arg == "self":
+            continue
+        tainted.add(p.arg)
+    for p in a.kwonlyargs:
+        if p.arg not in static_names:
+            tainted.add(p.arg)
+    if a.vararg:
+        tainted.add(a.vararg.arg)
+    if a.kwarg:
+        tainted.add(a.kwarg.arg)
+    return tainted
+
+
+def _propagate_taint(fn, tainted: set[str]) -> set[str]:
+    """Forward-propagate taint through simple assignments (two passes
+    cover loop-carried names)."""
+    body = fn.body if not isinstance(fn, ast.Lambda) else []
+    for _ in range(2):
+        for node in ast.walk(ast.Module(body=list(body),
+                                        type_ignores=[])):
+            targets = None
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+                value = node.value
+            elif isinstance(node, ast.For):
+                targets, value = [node.target], node.iter
+            elif isinstance(node, (ast.comprehension,)):
+                targets, value = [node.target], node.iter
+            if targets is None or value is None:
+                continue
+            if _names_in(value) & tainted:
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+    return tainted
+
+
+def _analyze_jit_body(ctx: _FileContext, fn, tainted: set[str]):
+    nodes = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+    wrapper = ast.Module(body=[], type_ignores=[])
+    for stmt in nodes:
+        wrapper.body.append(stmt)
+    fname = getattr(fn, "name", "<lambda>")
+    for node in ast.walk(wrapper):
+        if isinstance(node, ast.Call):
+            tgt = _dotted(node.func)
+            arg_names = set()
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                arg_names |= _names_in(a)
+            if (tgt and tgt.split(".")[0] in _NP_ALIASES
+                    and arg_names & tainted):
+                yield Violation(
+                    ctx.path, node.lineno, "tracer-hygiene",
+                    f"{tgt}() applied to traced value in jitted "
+                    f"'{fname}': numpy concretizes tracers (host "
+                    "round-trip or ConcretizationTypeError); use jnp")
+            elif (tgt in ("float", "int", "bool")
+                  and arg_names & tainted):
+                yield Violation(
+                    ctx.path, node.lineno, "tracer-hygiene",
+                    f"{tgt}() cast of traced value in jitted "
+                    f"'{fname}' forces concretization")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "item"
+                  and _names_in(node.func.value) & tainted):
+                yield Violation(
+                    ctx.path, node.lineno, "tracer-hygiene",
+                    f".item() on traced value in jitted '{fname}' "
+                    "forces a device sync")
+        elif isinstance(node, (ast.If, ast.While)):
+            test = node.test
+            # Identity tests (x is None) never read the traced value.
+            if (isinstance(test, ast.Compare)
+                    and all(isinstance(op, (ast.Is, ast.IsNot))
+                            for op in test.ops)):
+                continue
+            if _names_in(test) & tainted:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield Violation(
+                    ctx.path, test.lineno, "tracer-hygiene",
+                    f"python `{kind}` on traced value in jitted "
+                    f"'{fname}': branch is resolved at trace time "
+                    "(use jnp.where / lax.cond)")
+
+
+def check_tracer_hygiene(ctx: _FileContext):
+    for fn, nums, names in _jit_targets(ctx):
+        tainted = _tainted_params(fn, nums, names)
+        if not tainted:
+            continue
+        tainted = _propagate_taint(fn, set(tainted))
+        yield from _analyze_jit_body(ctx, fn, tainted)
+
+
+# ---------------------------------------------------------------------------
+# Rule: unlocked-shared-write
+# ---------------------------------------------------------------------------
+
+_MUTATORS = ("append", "extend", "insert", "add", "update", "clear",
+             "pop", "popitem", "remove", "discard", "setdefault",
+             "move_to_end", "sort")
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition")
+_SYNC_CTORS = _LOCK_CTORS + ("queue.Queue", "Queue", "threading.Event",
+                             "Event", "queue.LifoQueue",
+                             "queue.PriorityQueue")
+_THREAD_CTORS = ("threading.Thread", "Thread")
+_POOL_CTORS = ("ThreadPoolExecutor",
+               "concurrent.futures.ThreadPoolExecutor")
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _MethodInfo:
+    def __init__(self, node):
+        self.node = node
+        self.write_nodes: list[tuple[str, ast.AST]] = []  # attr, ast node
+        # (attr, line, locked, kind) — kind "rmw" | "rebind"
+        self.writes: list[tuple[str, int, bool, str]] = []
+        self.reads: set[str] = set()
+        self.calls: set[str] = set()    # self.X() method calls
+
+
+def _scan_class(cls: ast.ClassDef, par: dict):
+    methods: dict[str, _MethodInfo] = {}
+    workers: set[str] = set()
+    lock_attrs: set[str] = set()
+    sync_attrs: set[str] = set()
+
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        mi = _MethodInfo(item)
+        methods[item.name] = mi
+        for node in ast.walk(item):
+            if isinstance(node, ast.Call):
+                tgt = _dotted(node.func)
+                if tgt in _THREAD_CTORS:
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            attr = _self_attr(kw.value)
+                            if attr:
+                                workers.add(attr)
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "submit" and node.args):
+                    attr = _self_attr(node.args[0])
+                    if attr:
+                        workers.add(attr)
+                if isinstance(node.func, ast.Attribute):
+                    if _self_attr(node.func) is not None:
+                        # self.method(...)
+                        mi.calls.add(node.func.attr)
+                    else:
+                        m_attr = _self_attr(node.func.value)
+                        if m_attr is not None and \
+                                node.func.attr in _MUTATORS:
+                            # self.attr.append(...) etc.
+                            mi.write_nodes.append((m_attr, node))
+                if item.name == "__init__" and tgt in _SYNC_CTORS:
+                    assign = par.get(node)
+                    if isinstance(assign, ast.Assign):
+                        for t in assign.targets:
+                            attr = _self_attr(t)
+                            if attr:
+                                sync_attrs.add(attr)
+                                if tgt in _LOCK_CTORS:
+                                    lock_attrs.add(attr)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    elts = (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                            else [t])
+                    for e in elts:
+                        attr = _self_attr(e)
+                        if attr:
+                            mi.write_nodes.append((attr, node))
+            elif isinstance(node, ast.Attribute) and isinstance(
+                    node.ctx, ast.Load):
+                attr = _self_attr(node)
+                if attr:
+                    mi.reads.add(attr)
+    # Lock coverage is resolved after the whole class is scanned, so a
+    # lock attribute declared below its first use still counts.  Kind
+    # "rmw" = read-modify-write (AugAssign / container mutator) — the
+    # lost-update shape; "rebind" = plain assignment.
+    for mi in methods.values():
+        mi.writes = [(attr, node.lineno,
+                      _under_lock(node, par, lock_attrs),
+                      "rebind" if isinstance(node, ast.Assign) else "rmw")
+                     for attr, node in mi.write_nodes]
+    return methods, workers, lock_attrs, sync_attrs
+
+
+def _under_lock(node: ast.AST, par: dict, lock_attrs: set[str]) -> bool:
+    for anc in _ancestors(node, par):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                attr = _self_attr(item.context_expr)
+                if attr and (attr in lock_attrs
+                             or "lock" in attr.lower()):
+                    return True
+    return False
+
+
+def check_thread_discipline(ctx: _FileContext):
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods, workers, lock_attrs, sync_attrs = _scan_class(
+            cls, ctx.parents)
+        if not workers and not lock_attrs:
+            continue
+
+        # Worker-reachable closure over self.X() calls.
+        reach = set(workers)
+        frontier = list(workers)
+        while frontier:
+            m = frontier.pop()
+            if m not in methods:
+                continue
+            for callee in methods[m].calls:
+                if callee in methods and callee not in reach:
+                    reach.add(callee)
+                    frontier.append(callee)
+
+        worker_writes: dict[str, list] = {}
+        worker_reads: set[str] = set()
+        caller_access: set[str] = set()
+        caller_writes: dict[str, list] = {}
+        for name, mi in methods.items():
+            if name == "__init__":
+                continue
+            if name in reach:
+                for a, ln, locked, _kind in mi.writes:
+                    worker_writes.setdefault(a, []).append((ln, locked,
+                                                            name))
+                worker_reads |= mi.reads
+            else:
+                for a, ln, locked, _kind in mi.writes:
+                    caller_writes.setdefault(a, []).append((ln, locked,
+                                                            name))
+                caller_access |= mi.reads
+                caller_access |= {a for a, _, _, _ in mi.writes}
+
+        flagged: set[tuple[int, str]] = set()
+
+        def flag(attr, ln, method, side):
+            if (ln, attr) in flagged:
+                return None
+            flagged.add((ln, attr))
+            return Violation(
+                ctx.path, ln, "unlocked-shared-write",
+                f"'{cls.name}.{attr}' written in {method}() without "
+                f"the lock but shared with the {side} thread; guard "
+                "with the class lock or route through queue.Queue/"
+                "Event")
+
+        if workers:
+            for attr, writes in worker_writes.items():
+                if attr in sync_attrs or attr not in caller_access:
+                    continue
+                for ln, locked, m in writes:
+                    if not locked:
+                        v = flag(attr, ln, m, "caller")
+                        if v:
+                            yield v
+            for attr, writes in caller_writes.items():
+                if attr in sync_attrs:
+                    continue
+                if attr not in worker_reads and attr not in worker_writes:
+                    continue
+                for ln, locked, m in writes:
+                    if not locked:
+                        v = flag(attr, ln, m, "worker")
+                        if v:
+                            yield v
+        if lock_attrs:
+            # Lock-owning class: every non-init READ-MODIFY-WRITE
+            # (+=, container mutators — the lost-update shape) must
+            # hold the lock.  The ChunkStore discipline: `get`/`put`
+            # run on the prefetch thread and the main thread alike, so
+            # there is no single-threaded method to exempt.  Plain
+            # rebinds (e.g. a thread handle) are only flagged when the
+            # worker/caller sharing analysis above proves them shared.
+            for name, mi in methods.items():
+                if name == "__init__":
+                    continue
+                for attr, ln, locked, kind in mi.writes:
+                    if attr in sync_attrs or locked or kind != "rmw":
+                        continue
+                    if (ln, attr) in flagged:
+                        continue
+                    flagged.add((ln, attr))
+                    yield Violation(
+                        ctx.path, ln, "unlocked-shared-write",
+                        f"'{cls.name}.{attr}' mutated in {name}() "
+                        f"outside the class lock ({sorted(lock_attrs)})"
+                        "; lock-owning classes mutate shared state "
+                        "under it")
+
+
+# ---------------------------------------------------------------------------
+# Rule: accumulator-dtype
+# ---------------------------------------------------------------------------
+
+
+def _mentions_f32_or_device(node: ast.AST) -> str | None:
+    for n in ast.walk(node):
+        d = _dotted(n) if isinstance(n, (ast.Attribute, ast.Name)) else None
+        if d and d.split(".")[0] == "jnp":
+            return "jnp (device fold)"
+        if d and d.endswith("float32"):
+            return "float32 cast"
+        if isinstance(n, ast.Constant) and n.value == "float32":
+            return "float32 cast"
+    return None
+
+
+def check_accumulator_dtype(ctx: _FileContext):
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        names = {m.name for m in cls.body
+                 if isinstance(m, ast.FunctionDef)}
+        if not {"update", "result"} <= names:
+            continue
+        for method in cls.body:
+            if not isinstance(method, ast.FunctionDef):
+                continue
+            for node in ast.walk(method):
+                if isinstance(node, ast.AugAssign):
+                    attr = _self_attr(node.target)
+                    if attr is None:
+                        continue
+                    why = _mentions_f32_or_device(node.value)
+                    if why:
+                        yield Violation(
+                            ctx.path, node.lineno, "accumulator-dtype",
+                            f"accumulator '{cls.name}.{attr}' folds "
+                            f"through {why}; streaming metrics "
+                            "accumulate on host in float64")
+
+
+# ---------------------------------------------------------------------------
+# Rule: env-read
+# ---------------------------------------------------------------------------
+
+_ENV_SANCTIONED_FILES = ("config.py",)
+
+
+def check_env_read(ctx: _FileContext):
+    if os.path.basename(ctx.path) in _ENV_SANCTIONED_FILES:
+        return
+    for node in ast.walk(ctx.tree):
+        bad = None
+        if isinstance(node, ast.Attribute) and _dotted(node) in (
+                "os.environ",):
+            bad = "os.environ"
+        elif isinstance(node, ast.Call) and _dotted(node.func) in (
+                "os.getenv", "getenv"):
+            bad = "os.getenv"
+        elif (isinstance(node, ast.Name) and node.id == "environ"
+              and isinstance(node.ctx, ast.Load)):
+            bad = "environ"
+        if bad:
+            yield Violation(
+                ctx.path, node.lineno, "env-read",
+                f"raw {bad} read; route through "
+                "photon_ml_tpu.config.read_env (the sanctioned "
+                "registry) so every env knob is discoverable")
+
+
+# ---------------------------------------------------------------------------
+# Rule: slow-unmarked (repo-level: needs the recorded durations)
+# ---------------------------------------------------------------------------
+
+
+def _is_slow_mark(node: ast.AST) -> bool:
+    """Exactly ``[pytest.]mark.slow`` (optionally called) — a substring
+    test would false-match e.g. a skipif reason mentioning "slow"."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    return (_dotted(node) or "").endswith("mark.slow")
+
+
+def _test_has_slow(tree: ast.AST, func: str) -> bool:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "pytestmark":
+                    v = node.value
+                    marks = (v.elts if isinstance(v, (ast.List, ast.Tuple))
+                             else [v])
+                    if any(_is_slow_mark(m) for m in marks):
+                        return True
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == func:
+            if any(_is_slow_mark(d) for d in node.decorator_list):
+                return True
+    return False
+
+
+def check_slow_unmarked(root: str):
+    dur_path = os.path.join(root, DURATIONS_FILE)
+    if not os.path.exists(dur_path):
+        return
+    with open(dur_path) as f:
+        recorded = json.load(f)
+    durations = recorded.get("durations", recorded)
+    by_func: dict[tuple[str, str], float] = {}
+    for nodeid, secs in durations.items():
+        if "::" not in nodeid:
+            continue
+        file_part, test_part = nodeid.split("::", 1)
+        # Last :: segment = the function/method name (class-based tests
+        # produce file.py::TestCls::test_x; ast.walk in _test_has_slow
+        # visits methods, so the unqualified name is what matches).
+        func = test_part.split("[", 1)[0].split("::")[-1]
+        key = (file_part, func)
+        by_func[key] = max(by_func.get(key, 0.0), float(secs))
+    trees: dict[str, tuple] = {}
+    for (file_part, func), secs in sorted(by_func.items()):
+        if secs <= SLOW_THRESHOLD_S:
+            continue
+        path = os.path.join(root, file_part)
+        if not os.path.exists(path):
+            continue
+        if path not in trees:
+            with open(path) as f:
+                src = f.read()
+            ctx = _FileContext(path, src)   # parses once; .tree reused
+            trees[path] = (ctx.tree, ctx)
+        tree, ctx = trees[path]
+        if _test_has_slow(tree, func):
+            continue
+        line = 1
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and node.name == func:
+                line = node.lineno
+                break
+        v = Violation(
+            path, line, "slow-unmarked",
+            f"'{func}' measured {secs:.1f}s (> {SLOW_THRESHOLD_S:.0f}s "
+            "threshold) in the recorded tier-1 run but lacks "
+            "@pytest.mark.slow")
+        if not ctx.waived(line, "slow-unmarked"):
+            yield v
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+_FILE_CHECKERS = (
+    check_jit_in_function,
+    check_tracer_hygiene,
+    check_thread_discipline,
+    check_accumulator_dtype,
+    check_env_read,
+)
+
+
+def check_source(source: str, path: str = "<fixture>",
+                 rules=None) -> list[Violation]:
+    """Run the per-file checkers over one source string (the unit-test
+    surface for the fixture corpus)."""
+    ctx = _FileContext(path, source)
+    out: list[Violation] = []
+    for checker in _FILE_CHECKERS:
+        for v in checker(ctx):
+            if rules is not None and v.rule not in rules:
+                continue
+            if not ctx.waived(v.line, v.rule):
+                out.append(v)
+    if rules is None or "bad-waiver" in rules:
+        for line in ctx.bad_waivers:
+            out.append(Violation(
+                path, line, "bad-waiver",
+                "photon-lint waiver without a (reason); every "
+                "suppression must say why the contract does not apply"))
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+def _package_files(root: str) -> list[str]:
+    pkg = os.path.join(root, "photon_ml_tpu")
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def run_checks(root: str, rules=None, files=None):
+    """All violations for the repo at ``root`` (package files + the
+    recorded-duration test audit).  Returns (violations, files_checked).
+    """
+    targets = files if files is not None else _package_files(root)
+    violations: list[Violation] = []
+    for path in targets:
+        with open(path) as f:
+            source = f.read()
+        try:
+            violations.extend(check_source(source, path, rules=rules))
+        except SyntaxError as e:
+            if rules is None or "syntax-error" in rules:
+                violations.append(Violation(
+                    path, e.lineno or 1, "syntax-error", str(e)))
+    if rules is None or "slow-unmarked" in rules:
+        audited = list(check_slow_unmarked(root))
+        if files is not None:
+            # Explicit file list: the audit still runs (the JSON must
+            # not claim a requested rule ran when it did not), scoped
+            # to those files.
+            wanted = {os.path.abspath(p) for p in targets}
+            audited = [v for v in audited
+                       if os.path.abspath(v.path) in wanted]
+        violations.extend(audited)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations, len(targets)

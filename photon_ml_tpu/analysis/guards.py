@@ -1,0 +1,132 @@
+"""Runtime guard harness: compile / transfer / tracer-leak budgets.
+
+The static checkers prove the *code shape* keeps the rounds-6..10
+contracts; these context managers prove the *runtime* does:
+
+- ``count_compiles()``: every XLA compilation inside the scope,
+  counted by listening to ``jax.log_compiles`` output (the
+  "Compiling <name> with global shapes" records from
+  ``jax._src.interpreters.pxla``).  The budget assertions in the
+  hot-path tests pin them: a streaming L-BFGS sweep compiles the same
+  fixed program set whether the data is 4 chunks or 24 (the chunk
+  programs are shape-congruent -- PR 2/3's whole point), and a warm
+  re-fit compiles ZERO new programs.
+- ``no_implicit_transfers()``: ``jax.transfer_guard`` over the scope.
+  Planned transfers stay allowed -- chunk placement is an explicit
+  ``jax.device_put`` and result harvest an explicit
+  ``jax.device_get`` -- so any *implicit* host<->device copy inside a
+  per-chunk loop is a pipeline bug (an un-planned sync that the
+  prefetch overlap cannot hide).  NOTE: the CPU backend is exempt by
+  construction (host == device, jax raises no transfer events), so
+  the guard is load-bearing on TPU/GPU and structurally a no-op under
+  ``JAX_PLATFORMS=cpu`` -- tests wire it anyway so accelerator runs
+  inherit the contract.
+- ``tracer_leak_guard()``: ``jax.check_tracer_leaks`` over the scope;
+  a traced value escaping a jitted program (the classic closure leak)
+  becomes a loud error instead of a silent retrace anchor.
+
+All three nest and are reentrant-safe in the way the tests use them
+(one scope at a time per process; the compile listener is additive, so
+nested ``count_compiles`` scopes each see the inner compilations).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from contextlib import contextmanager
+
+# The pxla compile record: "Compiling <name> with global shapes and
+# types ...".  Keyed on the leading verb so tracing/lowering records
+# ("Finished tracing ...", "Finished XLA compilation ...") are not
+# double-counted.
+_COMPILE_RE = re.compile(r"^Compiling ([^\s]+)")
+
+
+class CompileLog:
+    """Collected compile events for one ``count_compiles`` scope."""
+
+    def __init__(self):
+        self.programs: list[str] = []
+
+    @property
+    def count(self) -> int:
+        return len(self.programs)
+
+    def named(self, *names: str) -> list[str]:
+        """Events whose program name matches any of ``names``
+        (budget assertions usually pin the interesting programs and
+        ignore the eager convert/broadcast helpers)."""
+        return [p for p in self.programs if p in names]
+
+    def __repr__(self) -> str:
+        return f"CompileLog(count={self.count}, programs={self.programs})"
+
+
+class _CompileHandler(logging.Handler):
+    def __init__(self, log: CompileLog):
+        super().__init__(level=logging.DEBUG)
+        self._log = log
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            m = _COMPILE_RE.match(record.getMessage())
+        except Exception:       # a guard must never break the run
+            return
+        if m:
+            # list.append is atomic under the GIL; compile records can
+            # arrive from dispatch on any thread.
+            self._log.programs.append(m.group(1))
+
+
+@contextmanager
+def count_compiles():
+    """Count XLA compilations in the scope (yields a ``CompileLog``).
+
+    Listens on the ``jax`` logger with ``jax.log_compiles`` enabled;
+    the records propagate from ``jax._src.interpreters.pxla``, one per
+    compiled program, named after the jitted callable -- so budget
+    tests can assert both totals and per-program presence."""
+    import jax
+
+    log = CompileLog()
+    handler = _CompileHandler(log)
+    jax_logger = logging.getLogger("jax")
+    old_level = jax_logger.level
+    jax_logger.addHandler(handler)
+    # The handler must SEE the records: compile records are emitted at
+    # WARNING by log_compiles, and the jax logger is normally NOTSET —
+    # its EFFECTIVE level comes from the root logger, so an app that
+    # configured root above WARNING would silently drop every record
+    # (and make all zero-compile budget assertions pass vacuously).
+    if jax_logger.getEffectiveLevel() > logging.WARNING:
+        jax_logger.setLevel(logging.WARNING)
+    try:
+        with jax.log_compiles():
+            yield log
+    finally:
+        jax_logger.removeHandler(handler)
+        jax_logger.setLevel(old_level)
+
+
+@contextmanager
+def no_implicit_transfers(level: str = "disallow"):
+    """Forbid (or ``level="log"``: report) implicit host<->device
+    transfers in the scope.  Explicit ``jax.device_put`` /
+    ``jax.device_get`` -- the planned chunk placement and harvest --
+    stay allowed; anything else inside a per-chunk loop is an
+    unplanned sync.  No-op on the CPU backend (host == device)."""
+    import jax
+
+    with jax.transfer_guard(level):
+        yield
+
+
+@contextmanager
+def tracer_leak_guard():
+    """Raise on tracers escaping a jitted scope
+    (``jax.check_tracer_leaks``)."""
+    import jax
+
+    with jax.check_tracer_leaks():
+        yield

@@ -36,7 +36,9 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     to minutes of XLA time each) all persist without caching the
     dispatch-layer trivia.  Returns the directory in effect."""
     global _enabled_dir
-    cache_dir = cache_dir or os.environ.get(ENV_VAR)
+    from photon_ml_tpu.config import read_env
+
+    cache_dir = cache_dir or read_env(ENV_VAR)
     if not cache_dir:
         return None
     xla_dir = os.path.join(os.path.abspath(cache_dir), "xla")

@@ -154,13 +154,15 @@ def distributed_init_from_env() -> None:
             return _dist.global_state.client is not None
     if is_init():
         return
+    from photon_ml_tpu.config import read_env
+
     kw = {}
-    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
-        kw["coordinator_address"] = os.environ["JAX_COORDINATOR_ADDRESS"]
-    if os.environ.get("JAX_NUM_PROCESSES"):
-        kw["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
-    if os.environ.get("JAX_PROCESS_ID"):
-        kw["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    if read_env("JAX_COORDINATOR_ADDRESS"):
+        kw["coordinator_address"] = read_env("JAX_COORDINATOR_ADDRESS")
+    if read_env("JAX_NUM_PROCESSES"):
+        kw["num_processes"] = int(read_env("JAX_NUM_PROCESSES"))
+    if read_env("JAX_PROCESS_ID"):
+        kw["process_id"] = int(read_env("JAX_PROCESS_ID"))
     jax.distributed.initialize(**kw)
 
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import json
+import os
 from typing import Any
 
 from photon_ml_tpu.data.normalization import NormalizationType
@@ -25,6 +26,49 @@ from photon_ml_tpu.models.glm import TaskType
 from photon_ml_tpu.ops.regularization import RegularizationType
 from photon_ml_tpu.optim.base import OptimizerType
 from photon_ml_tpu.optim.variance import VarianceComputationType
+
+
+# ---------------------------------------------------------------------------
+# Sanctioned environment fallbacks.  Every env knob the package reads is
+# registered HERE with its meaning, and read through ``read_env`` —
+# scattered raw ``os.environ`` reads are invisible configuration, and
+# the photon-lint ``env-read`` rule rejects them anywhere else.
+# ---------------------------------------------------------------------------
+
+SANCTIONED_ENV = {
+    "PHOTON_ML_TPU_PLAN_CACHE": (
+        "default on-disk GRR plan cache dir (data.grr cache_dir=None)"),
+    "PHOTON_ML_TPU_COMPILE_CACHE": (
+        "default persistent XLA compilation cache dir (cache"
+        ".compile_cache)"),
+    "PHOTON_ML_TPU_SPILL_DIR": (
+        "default chunk-store spill dir (data.chunk_store"
+        ".resolve_spill_dir)"),
+    "PHOTON_ML_TPU_NATIVE": (
+        "'0' forces the numpy ETL fallbacks (native bindings disabled)"),
+    "PHOTON_ML_TPU_GRR": (
+        "'0' forces the XLA fallback contraction off the Pallas kernel"),
+    "PHOTON_ML_TPU_BENCH_CACHE": (
+        "bench.py artifact cache dir override"),
+    "JAX_COORDINATOR_ADDRESS": (
+        "jax.distributed coordinator (multi-host init, training driver)"),
+    "JAX_NUM_PROCESSES": "jax.distributed process count",
+    "JAX_PROCESS_ID": "jax.distributed process id",
+}
+
+
+def read_env(name: str, default: str | None = None) -> str | None:
+    """The one sanctioned ``os.environ`` read.
+
+    Raises ``KeyError`` for an unregistered name — adding an env knob
+    means registering it in ``SANCTIONED_ENV`` (with its meaning), so
+    ``python -m photon_ml_tpu.analysis`` plus this registry is a
+    complete inventory of the package's environment surface."""
+    if name not in SANCTIONED_ENV:
+        raise KeyError(
+            f"env var {name!r} is not in config.SANCTIONED_ENV; "
+            "register it (with a description) before reading it")
+    return os.environ.get(name, default)
 
 
 class CoordinateKind(str, enum.Enum):

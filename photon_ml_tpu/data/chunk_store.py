@@ -88,7 +88,9 @@ def resolve_spill_dir(spill_dir: str | None) -> str | None:
     (chunks stay host-resident, the pre-round-8 behavior)."""
     if spill_dir is not None:
         return spill_dir
-    return os.environ.get("PHOTON_ML_TPU_SPILL_DIR") or None
+    from photon_ml_tpu.config import read_env
+
+    return read_env("PHOTON_ML_TPU_SPILL_DIR") or None
 
 
 def store_key(rows, labels: np.ndarray, weights: np.ndarray, dim: int,
@@ -439,7 +441,10 @@ class ChunkStore:
 
         meta, arrays = self._encode(chunk)
         atomic_savez(self.path(i), meta, arrays)
-        self.spills += 1
+        with self._lock:
+            # ``put`` runs on the build thread AND (rebuild re-spill)
+            # the prefetch thread — the counter is shared state.
+            self.spills += 1
         if keep_resident is None:
             keep_resident = i < self.host_max_resident
         if keep_resident:

@@ -71,9 +71,9 @@ PLAN_CACHE_ENV = "PHOTON_ML_TPU_PLAN_CACHE"
 
 
 def _resolve_cache_dir(cache_dir: "str | None") -> "str | None":
-    import os
+    from photon_ml_tpu.config import read_env
 
-    return cache_dir or os.environ.get(PLAN_CACHE_ENV) or None
+    return cache_dir or read_env(PLAN_CACHE_ENV) or None
 
 
 class _SpillWarnings:
@@ -253,8 +253,7 @@ class GrrDirection:
 
     def contract(self, table: Array) -> Array:
         """``out[s] = Σ val_e · table[idx_e]`` for this plan — [n_segments]."""
-        import os
-
+        from photon_ml_tpu.config import read_env
         from photon_ml_tpu.ops.grr_kernel import (
             grr_contract_jnp,
             grr_contract_jnp_dense,
@@ -272,7 +271,7 @@ class GrrDirection:
 
         use_kernel = (
             jax.default_backend() == "tpu"
-            and os.environ.get("PHOTON_ML_TPU_GRR") != "0"
+            and read_env("PHOTON_ML_TPU_GRR") != "0"
         )
         if self.dense_grid:
             if use_kernel:

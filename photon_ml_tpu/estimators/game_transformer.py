@@ -27,6 +27,7 @@ The per-coordinate helpers here are shared by both paths.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +53,18 @@ _DEVICE_SCORE_MIN_ROWS = 200_000
 _DEVICE_SCORE_CHUNK = 2_000_000
 
 
+@functools.lru_cache(maxsize=None)
+def _jit_scorer(fn):
+    """Memoized jit wrapper: a per-call ``jax.jit(gather_rowsum)``
+    gave every ``_device_score_sparse`` invocation a fresh executable
+    cache, re-tracing and recompiling the identical program once per
+    scoring call (photon-lint jit-in-function; the PR-2 recompile
+    hazard, found at lint introduction).  Keyed on the function object
+    so the production path reuses ONE compiled wrapper while a
+    monkeypatched spy (tests) transparently gets its own."""
+    return jax.jit(fn)
+
+
 def _device_score_sparse(rows, w_np: np.ndarray) -> np.ndarray:
     """Chunked device X·w over SparseRows: equal-shape ELL chunks (the
     tail is padded, so ONE compile serves every chunk), with at most
@@ -65,13 +78,13 @@ def _device_score_sparse(rows, w_np: np.ndarray) -> np.ndarray:
     fixed 2M grid made a 250k-row input pay ~8× wasted
     gather/rowsum/transfer); one compile still serves every chunk of a
     given input."""
-    from photon_ml_tpu.ops.kernels import gather_rowsum
+    from photon_ml_tpu.ops import kernels
 
     n = len(rows)
     k = max(rows.max_nnz, 1)
     grid = -(-min(n, _DEVICE_SCORE_CHUNK) // 8192) * 8192
     w_dev = jnp.asarray(w_np, jnp.float32)
-    score = jax.jit(gather_rowsum)
+    score = _jit_scorer(kernels.gather_rowsum)
     outs = []
     pending: list = []
     for lo in range(0, n, grid):

@@ -123,35 +123,46 @@ class _SinkWriter:
     def __init__(self, sinks):
         self._sinks = list(sinks)
         self._q: queue.Queue = queue.Queue(maxsize=4)
+        # _error crosses threads (written by the writer, read by the
+        # producer mid-stream in put()), so it lives under a lock —
+        # the photon-lint unlocked-shared-write contract.
+        self._lock = threading.Lock()
         self._error: BaseException | None = None
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="photon-score-writer")
         self._thread.start()
+
+    def _failed(self) -> "BaseException | None":
+        with self._lock:
+            return self._error
 
     def _run(self) -> None:
         while True:
             item = self._q.get()
             if item is self._SENTINEL:
                 return
-            if self._error is not None:
+            if self._failed() is not None:
                 continue       # drain without writing after a failure
             try:
                 lo, hi, margins, preds, labels, ids = item
                 for s in self._sinks:
                     s.write(lo, hi, margins, preds, labels, ids=ids)
             except BaseException as e:
-                self._error = e
+                with self._lock:
+                    self._error = e
 
     def put(self, lo, hi, margins, preds, labels, ids) -> None:
-        if self._error is not None:
-            raise self._error
+        err = self._failed()
+        if err is not None:
+            raise err
         self._q.put((lo, hi, margins, preds, labels, ids))
 
     def close(self) -> None:
         self._q.put(self._SENTINEL)
         self._thread.join()
-        if self._error is not None:
-            raise self._error
+        err = self._failed()
+        if err is not None:
+            raise err
 
 
 def _fingerprint_arrays(parts, extra: str = "") -> str:
@@ -418,8 +429,10 @@ class StreamingGameScorer:
             i, m_dev, p_dev = item
             lo = i * R
             hi = min(lo + R, n)
-            m = np.asarray(m_dev)[: hi - lo]
-            p = np.asarray(p_dev)[: hi - lo]
+            # Planned D2H harvest spelled explicitly (device_get) so the
+            # chunk loop stays clean under guards.no_implicit_transfers.
+            m = jax.device_get(m_dev)[: hi - lo]
+            p = jax.device_get(p_dev)[: hi - lo]
             lab = labels[lo:hi]
             for ev in evaluators:
                 ev.update(m, p, lab, weights[lo:hi])

@@ -117,9 +117,12 @@ def _jit_solve(fn, donate_argnums):
     arrays, so the plain variant stays the default — donation is
     opt-in via ``donate_warm_start``.
     """
-    return (jax.jit(fn, static_argnums=(0, 1, 2)),
-            jax.jit(fn, static_argnums=(0, 1, 2),
-                    donate_argnums=donate_argnums))
+    return (
+        # photon-lint: disable=jit-in-function (module-import-time factory)
+        jax.jit(fn, static_argnums=(0, 1, 2)),
+        # photon-lint: disable=jit-in-function (module-import-time factory)
+        jax.jit(fn, static_argnums=(0, 1, 2),
+                donate_argnums=donate_argnums))
 
 
 def _fixed_train_local_impl(optimizer, config, has_l1, objective, batch,

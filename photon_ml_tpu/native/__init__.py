@@ -61,10 +61,12 @@ def lib() -> "ctypes.CDLL | None":
     global _lib
     if _lib is not False:
         return _lib  # type: ignore[return-value]
+    from photon_ml_tpu.config import read_env
+
     with _lock:
         if _lib is not False:
             return _lib  # type: ignore[return-value]
-        if os.environ.get("PHOTON_ML_TPU_NATIVE") == "0":
+        if read_env("PHOTON_ML_TPU_NATIVE") == "0":
             _lib = None
             return None
         if not os.path.exists(_SO) or (

@@ -124,7 +124,6 @@ class ChunkPrefetcher:
         self._store = store
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
-        self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
 
     def start(self, order) -> None:
@@ -153,9 +152,12 @@ class ChunkPrefetcher:
                 buf = self._place(host)              # host -> device
                 if not self._put((i, host, buf)):
                     return
-        except BaseException as e:   # surfaced at the consumer's next()
-            self._error = e
-            self._put((self._SENTINEL, None, None))
+        except BaseException as e:
+            # The error RIDES THE QUEUE to the consumer: an attribute
+            # would be an unlocked cross-thread write (photon-lint
+            # unlocked-shared-write); the queue's internal lock gives
+            # the happens-before edge for free.
+            self._put((self._SENTINEL, e, None))
         finally:
             if self._store is not None:
                 self._store.end_read()
@@ -165,7 +167,7 @@ class ChunkPrefetcher:
         asserts the deterministic order."""
         i, host, buf = self._q.get()
         if i is self._SENTINEL:
-            raise self._error
+            raise host   # the producer's exception, delivered in-band
         if i != expect:
             raise AssertionError(
                 f"prefetch order violated: got chunk {i}, "
@@ -568,7 +570,12 @@ class ChunkedGLMObjective:
             pending.append((m, hi - lo))
         if not pending:
             return np.zeros(0, np.float32)
-        return np.concatenate([np.asarray(m)[:rows] for m, rows in pending])
+        # device_get, not np.asarray: the harvest is a PLANNED
+        # device-to-host copy, and the explicit spelling keeps it
+        # allowed under guards.no_implicit_transfers (the async copies
+        # above already landed most bytes; this just materializes).
+        return np.concatenate(
+            [jax.device_get(m)[:rows] for m, rows in pending])
 
     def predict_margins(self, w: Array) -> np.ndarray:
         """Per-example margins (offsets included) over all chunks."""

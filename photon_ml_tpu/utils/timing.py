@@ -70,5 +70,6 @@ def measure_scanned(fn: Callable, *args, length: int = 10,
         out, _ = jax.lax.scan(body, carry, None, length=length)
         return out
 
+    # photon-lint: disable=jit-in-function (measurement harness, by design)
     chained = jax.jit(chain)
     return measure(chained, *args, iters=iters) / length

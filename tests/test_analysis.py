@@ -1,0 +1,708 @@
+"""photon-lint checker suite (ISSUE 6): known-bad fixture snippets per
+rule (positive + negative + waiver cases), the whole-repo clean-pass
+gate, and the CLI contract (rc 0/1, JSON last line, github format).
+
+``test_repo_clean`` IS the CI wiring: ``pytest tests/`` fails if any
+package file regresses a lint contract, exactly like a broken unit
+test.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from photon_ml_tpu.analysis.checkers import (
+    RULES,
+    check_slow_unmarked,
+    check_source,
+    run_checks,
+)
+
+pytestmark = pytest.mark.fast
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(violations):
+    return [v.rule for v in violations]
+
+
+def _src(snippet: str) -> str:
+    return textwrap.dedent(snippet).lstrip("\n")
+
+
+# ---------------------------------------------------------------------------
+# jit-in-function
+# ---------------------------------------------------------------------------
+
+
+def test_jit_in_function_flags_body_construction():
+    vs = check_source(_src("""
+        import jax
+
+        def g(x):
+            return x
+
+        def scorer(x):
+            f = jax.jit(g)
+            return f(x)
+    """))
+    assert _rules(vs) == ["jit-in-function"]
+    assert vs[0].line == 7
+
+
+def test_jit_in_function_flags_partial_and_loops():
+    vs = check_source(_src("""
+        import jax
+        from functools import partial
+
+        def g(x):
+            return x
+
+        def build():
+            return partial(jax.jit, static_argnums=0)(g)
+
+        fns = []
+        for _ in range(3):
+            fns.append(jax.jit(g))
+    """))
+    assert _rules(vs) == ["jit-in-function", "jit-in-function"]
+
+
+def test_jit_in_function_flags_nested_decorated_def():
+    vs = check_source(_src("""
+        import jax
+
+        def outer():
+            @jax.jit
+            def inner(x):
+                return x
+            return inner
+    """))
+    assert _rules(vs) == ["jit-in-function"]
+
+
+def test_jit_at_module_level_is_clean():
+    vs = check_source(_src("""
+        import jax
+        from functools import partial
+
+        def g(x):
+            return x
+
+        f1 = jax.jit(g)
+        f2 = jax.jit(lambda x: x + 1)
+
+        @jax.jit
+        def f3(x):
+            return x
+
+        @partial(jax.jit, static_argnums=(0,))
+        def f4(k, x):
+            return x * k
+    """))
+    assert vs == []
+
+
+def test_jit_in_memoized_factory_is_clean():
+    vs = check_source(_src("""
+        import functools
+        import jax
+
+        def g(x):
+            return x
+
+        @functools.lru_cache(maxsize=None)
+        def jitted():
+            return jax.jit(g)
+    """))
+    assert vs == []
+
+
+def test_jit_waiver_with_reason_suppresses():
+    vs = check_source(_src("""
+        import jax
+
+        def g(x):
+            return x
+
+        def harness():
+            # photon-lint: disable=jit-in-function (measured by design)
+            return jax.jit(g)
+    """))
+    assert vs == []
+
+
+def test_waiver_without_reason_is_rejected():
+    vs = check_source(_src("""
+        import jax
+
+        def g(x):
+            return x
+
+        def harness():
+            return jax.jit(g)  # photon-lint: disable=jit-in-function
+    """))
+    assert sorted(_rules(vs)) == ["bad-waiver", "jit-in-function"]
+
+
+# ---------------------------------------------------------------------------
+# tracer-hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_hygiene_flags_numpy_on_traced():
+    vs = check_source(_src("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.sum(x)
+    """))
+    assert _rules(vs) == ["tracer-hygiene"]
+    assert "np.sum" in vs[0].message
+
+
+def test_tracer_hygiene_flags_casts_and_item():
+    vs = check_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            a = float(x)
+            b = int(x)
+            c = x.item()
+            return a + b + c
+    """))
+    assert _rules(vs) == ["tracer-hygiene"] * 3
+
+
+def test_tracer_hygiene_flags_branch_on_traced():
+    vs = check_source(_src("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = x * 2
+            if y > 0:
+                return y
+            return -y
+    """))
+    assert _rules(vs) == ["tracer-hygiene"]
+    assert "branch" in vs[0].message
+
+
+def test_tracer_hygiene_follows_module_level_jit_assignment():
+    vs = check_source(_src("""
+        import jax
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x)
+
+        f_jit = jax.jit(f)
+    """))
+    assert _rules(vs) == ["tracer-hygiene"]
+
+
+def test_tracer_hygiene_respects_static_argnums_and_identity():
+    vs = check_source(_src("""
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(0,))
+        def f(cfg, x, l1=None):
+            if cfg.use_bias:          # static arg: trace-time branch OK
+                x = x + 1.0
+            if l1 is None:            # identity test never reads value
+                return jnp.sum(x)
+            return jnp.sum(x) + jnp.sum(l1)
+    """))
+    assert vs == []
+
+
+def test_tracer_hygiene_clean_jnp_body():
+    vs = check_source(_src("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = jnp.where(x > 0, x, -x)
+            return jnp.sum(y)
+    """))
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# unlocked-shared-write
+# ---------------------------------------------------------------------------
+
+_THREADED_BAD = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._thread = None
+            self.result = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+
+        def _run(self):
+            self.result = 42
+
+        def get(self):
+            return self.result
+"""
+
+
+def test_thread_discipline_flags_unlocked_shared_write():
+    vs = check_source(_src(_THREADED_BAD))
+    assert _rules(vs) == ["unlocked-shared-write"]
+    assert "Worker.result" in vs[0].message
+
+
+def test_thread_discipline_accepts_locked_and_queue():
+    vs = check_source(_src("""
+        import queue
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+                self._thread = None
+                self.result = None
+
+            def start(self):
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                with self._lock:
+                    self.result = 42
+                self._q.put(42)
+
+            def get(self):
+                with self._lock:
+                    return self.result
+    """))
+    assert vs == []
+
+
+def test_thread_discipline_lock_owner_must_hold_lock():
+    vs = check_source(_src("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.loads = 0
+                self.spills = 0
+
+            def load(self):
+                with self._lock:
+                    self.loads += 1
+
+            def spill(self):
+                self.spills += 1
+    """))
+    assert _rules(vs) == ["unlocked-shared-write"]
+    assert "Store.spills" in vs[0].message
+
+
+def test_thread_discipline_waiver():
+    bad = _src(_THREADED_BAD).replace(
+        "self.result = 42",
+        "self.result = 42  "
+        "# photon-lint: disable=unlocked-shared-write (join fences it)",
+        1)
+    assert check_source(bad) == []
+
+
+# ---------------------------------------------------------------------------
+# accumulator-dtype
+# ---------------------------------------------------------------------------
+
+
+def test_accumulator_dtype_flags_device_fold():
+    vs = check_source(_src("""
+        import jax.numpy as jnp
+
+        class StreamingLoss:
+            def __init__(self):
+                self._num = 0.0
+
+            def update(self, scores):
+                self._num += jnp.sum(scores)
+
+            def result(self):
+                return self._num
+    """))
+    assert _rules(vs) == ["accumulator-dtype"]
+    assert "jnp" in vs[0].message
+
+
+def test_accumulator_dtype_flags_f32_fold():
+    vs = check_source(_src("""
+        import numpy as np
+
+        class StreamingLoss:
+            def __init__(self):
+                self._num = 0.0
+
+            def update(self, scores):
+                self._num += np.sum(scores.astype(np.float32))
+
+            def result(self):
+                return self._num
+    """))
+    assert _rules(vs) == ["accumulator-dtype"]
+
+
+def test_accumulator_dtype_accepts_host_f64():
+    vs = check_source(_src("""
+        import numpy as np
+
+        class StreamingLoss:
+            def __init__(self):
+                self._num = 0.0
+                self._den = 0.0
+
+            def update(self, scores, weights):
+                w = np.asarray(weights, np.float64)
+                self._num += float(np.sum(w * scores))
+                self._den += float(np.sum(w))
+
+            def result(self):
+                return self._num / self._den
+    """))
+    assert vs == []
+
+
+def test_accumulator_dtype_ignores_non_accumulator_classes():
+    vs = check_source(_src("""
+        import jax.numpy as jnp
+
+        class NotAnAccumulator:
+            def update(self, x):
+                self._x += jnp.sum(x)   # no result(): protocol not met
+    """))
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# env-read
+# ---------------------------------------------------------------------------
+
+
+def test_env_read_flags_all_forms():
+    vs = check_source(_src("""
+        import os
+        from os import environ
+
+        a = os.environ.get("PHOTON_X")
+        b = os.environ["PHOTON_Y"]
+        c = os.getenv("PHOTON_Z")
+        d = environ.get("PHOTON_W")
+    """))
+    assert _rules(vs) == ["env-read"] * 4
+
+
+def test_env_read_sanctioned_in_config():
+    vs = check_source(_src("""
+        import os
+
+        def read_env(name):
+            return os.environ.get(name)
+    """), path="photon_ml_tpu/config.py")
+    assert vs == []
+
+
+def test_env_read_waiver():
+    vs = check_source(_src("""
+        import os
+
+        # photon-lint: disable=env-read (documented bootstrap read)
+        a = os.environ.get("PHOTON_X")
+    """))
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# slow-unmarked (repo-level, recorded durations)
+# ---------------------------------------------------------------------------
+
+
+def test_slow_unmarked_against_recorded_durations(tmp_path):
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_things.py").write_text(_src("""
+        import pytest
+
+        @pytest.mark.slow
+        def test_marked():
+            pass
+
+        def test_unmarked():
+            pass
+
+        def test_fast_one():
+            pass
+    """))
+    (tests_dir / "tier1_durations.json").write_text(json.dumps({
+        "durations": {
+            "tests/test_things.py::test_marked": 19.0,
+            "tests/test_things.py::test_unmarked[a]": 17.5,
+            "tests/test_things.py::test_unmarked[b]": 1.0,
+            "tests/test_things.py::test_fast_one": 0.2,
+        }}))
+    vs = list(check_slow_unmarked(str(tmp_path)))
+    assert _rules(vs) == ["slow-unmarked"]
+    assert "test_unmarked" in vs[0].message and "17.5" in vs[0].message
+
+
+def test_slow_unmarked_not_fooled_by_slow_substring(tmp_path):
+    """Only a real ``pytest.mark.slow`` counts — a skipif reason (or
+    any decorator) merely CONTAINING "slow" must not satisfy the
+    audit."""
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_things.py").write_text(_src("""
+        import pytest
+
+        @pytest.mark.skipif(False, reason="too slow without gpu")
+        def test_heavy():
+            pass
+    """))
+    (tests_dir / "tier1_durations.json").write_text(json.dumps(
+        {"durations": {"tests/test_things.py::test_heavy": 30.0}}))
+    vs = list(check_slow_unmarked(str(tmp_path)))
+    assert _rules(vs) == ["slow-unmarked"]
+
+
+def test_waiver_in_docstring_is_inert():
+    """A waiver example quoted inside a string/docstring is not a real
+    waiver: it must neither suppress the next code line nor be
+    reported as a bad waiver."""
+    vs = check_source(_src('''
+        import os
+
+        DOC = """
+        Example:
+            # photon-lint: disable=env-read (docs example)
+        """
+        a = os.environ.get("PHOTON_X")
+
+        BAD_DOC = "# photon-lint: disable=env-read"
+    '''))
+    assert _rules(vs) == ["env-read"]
+
+
+def test_slow_unmarked_class_based_nodeids(tmp_path):
+    """Class-based node ids (file.py::TestCls::test_x) resolve to the
+    method: a marked method passes, an unmarked sibling is flagged at
+    its own def line (not line 1)."""
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_cls.py").write_text(_src("""
+        import pytest
+
+        class TestTransforms:
+            @pytest.mark.slow
+            def test_marked(self):
+                pass
+
+            def test_unmarked(self):
+                pass
+    """))
+    (tests_dir / "tier1_durations.json").write_text(json.dumps(
+        {"durations": {
+            "tests/test_cls.py::TestTransforms::test_marked": 42.0,
+            "tests/test_cls.py::TestTransforms::test_unmarked": 12.0,
+        }}))
+    vs = list(check_slow_unmarked(str(tmp_path)))
+    assert _rules(vs) == ["slow-unmarked"]
+    assert "test_unmarked" in vs[0].message and vs[0].line > 1
+
+
+def test_slow_unmarked_accepts_module_pytestmark(tmp_path):
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_mod.py").write_text(_src("""
+        import pytest
+
+        pytestmark = pytest.mark.slow
+
+        def test_heavy():
+            pass
+    """))
+    (tests_dir / "tier1_durations.json").write_text(json.dumps(
+        {"durations": {"tests/test_mod.py::test_heavy": 30.0}}))
+    assert list(check_slow_unmarked(str(tmp_path))) == []
+
+
+# ---------------------------------------------------------------------------
+# the acceptance corpus + whole-repo gate + CLI contract
+# ---------------------------------------------------------------------------
+
+_CORPUS = """
+    import os
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+
+    def per_call(x):
+        return jax.jit(lambda y: y)(x)
+
+
+    @jax.jit
+    def concretizes(x):
+        return float(np.sum(x))
+
+
+    class Pipeline:
+        def __init__(self):
+            self._thread = None
+            self.state = 0
+
+        def start(self):
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+
+        def _run(self):
+            self.state = 1
+
+        def poll(self):
+            return self.state
+
+
+    class StreamingThing:
+        def __init__(self):
+            self._acc = 0.0
+
+        def update(self, x):
+            self._acc += jnp.sum(x)
+
+        def result(self):
+            return self._acc
+
+
+    FLAG = os.environ.get("SOME_UNSANCTIONED_FLAG")
+"""
+
+
+def test_fixture_corpus_detects_five_distinct_rules():
+    """The ISSUE acceptance check: one source exercising the suite
+    trips >= 5 distinct rules."""
+    vs = check_source(_src(_CORPUS))
+    distinct = set(_rules(vs))
+    assert {"jit-in-function", "tracer-hygiene", "unlocked-shared-write",
+            "accumulator-dtype", "env-read"} <= distinct
+    assert len(distinct) >= 5
+
+
+def test_repo_clean():
+    """Tier-1 gate: the package (and the recorded-duration audit) is
+    lint-clean.  A failure here reads exactly like the CLI output —
+    fix the violation or add a reasoned waiver."""
+    violations, n_files = run_checks(REPO_ROOT)
+    assert n_files > 50
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_cli_contract_clean_and_violating(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # Clean run over the repo: rc 0 + JSON last line.
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.analysis"],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    tail = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert tail["ok"] is True and tail["violations"] == 0
+    assert set(tail["rules_run"]) == set(RULES)
+
+    # Violating file: rc 1, one line per violation, JSON tail counts.
+    bad = tmp_path / "bad.py"
+    bad.write_text(_src(_CORPUS))
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.analysis", str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env)
+    assert proc.returncode == 1
+    lines = proc.stdout.strip().splitlines()
+    tail = json.loads(lines[-1])
+    assert tail["ok"] is False
+    assert tail["violations"] == len(lines) - 1 >= 5
+    assert all(":" in ln for ln in lines[:-1])
+
+
+def test_cli_github_format(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_src("""
+        import os
+
+        FLAG = os.environ.get("SOME_FLAG")
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.analysis",
+         "--format", "github", str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 1
+    lines = proc.stdout.strip().splitlines()
+    assert lines[0].startswith("::error file=")
+    assert "title=env-read" in lines[0]
+    # Annotation paths are emitted repo-relative (GitHub only attaches
+    # `file=` values relative to the workspace), never absolute.
+    assert "file=/" not in lines[0]
+    json.loads(lines[-1])
+
+
+def test_cli_rule_filter(tmp_path):
+    bad = tmp_path / "bad.py"
+    # A reasonless waiver rides along: the bad-waiver meta-rule must
+    # honor the filter too (a job scoped to env-read must not fail on
+    # an unrelated finding class).
+    bad.write_text(_src(_CORPUS) + "\nX = 1  # photon-lint: disable=env-read\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.analysis",
+         "--rules", "env-read", str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 1
+    tail = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert tail["by_rule"] == {"env-read": 1}
+
+
+def test_run_checks_explicit_files_still_audit_slow(tmp_path):
+    """Passing explicit files must not silently drop a requested
+    slow-unmarked audit — it runs scoped to those files."""
+    from photon_ml_tpu.analysis.checkers import run_checks
+
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    tfile = tests_dir / "test_big.py"
+    tfile.write_text("def test_heavy():\n    pass\n")
+    (tests_dir / "tier1_durations.json").write_text(json.dumps(
+        {"durations": {"tests/test_big.py::test_heavy": 25.0}}))
+    vs, _n = run_checks(str(tmp_path), rules={"slow-unmarked"},
+                        files=[str(tfile)])
+    assert [v.rule for v in vs] == ["slow-unmarked"]
+    other = tests_dir / "test_other.py"
+    other.write_text("def test_ok():\n    pass\n")
+    vs, _n = run_checks(str(tmp_path), rules={"slow-unmarked"},
+                        files=[str(other)])
+    assert vs == []   # scoped: the flagged file was not requested

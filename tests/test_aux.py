@@ -60,6 +60,7 @@ def test_donating_solve_matches_plain(rng):
                                np.asarray(res_don.w), rtol=1e-6)
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_timed_profile_dir_writes_trace(tmp_path):
     log = RunLogger(path=None)
     prof_dir = str(tmp_path / "trace")

@@ -61,6 +61,7 @@ def test_bench_cached_section_records_warm_vs_cold(tmp_path):
     assert cached["parity_ok"] is True
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_bench_sweep_section_contract(tmp_path):
     """`--section sweep` keeps the budget/JSON-last-line contract and
     records the batched-vs-sequential λ-sweep measurement: wall times,
@@ -87,6 +88,7 @@ def test_bench_sweep_section_contract(tmp_path):
     assert sweep["pass_amortization"] >= 2.0
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_bench_stream_section_contract(tmp_path):
     """`--section stream` keeps the budget/JSON-last-line contract and
     records the out-of-core measurement: per-arm wall-clock and peak
@@ -94,7 +96,7 @@ def test_bench_stream_section_contract(tmp_path):
     gradient parity across arms, and the per-section peak_rss_mb
     trajectory satellite."""
     proc = _run_bench(tmp_path, "--section", "stream",
-                      "--budget-s", "240", *_TINY)
+                      "--budget-s", "240", "--guards", *_TINY)
     assert proc.returncode == 0, proc.stderr[-3000:]
     rec = json.loads(
         [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
@@ -102,6 +104,12 @@ def test_bench_stream_section_contract(tmp_path):
     assert rec.get("errors") is None
     s = rec["stream"]
     assert s["host_max_resident"] == 2
+    # --guards (ISSUE 6): the timed sweeps ran under the runtime guard
+    # harness and the steady state compiled NOTHING (everything was
+    # compiled in the warmup; a per-sweep retrace would count here).
+    for arm in ("spilled", "resident"):
+        assert s[arm]["guards"]["sweep_compiles"] == 0, \
+            s[arm]["guards"]
     # Chunks must dwarf the window (the RSS-bound claim's precondition)
     assert s["n_chunks"] >= 6 * s["host_max_resident"]
     # LRU bound held during the spilled arm's sweeps.
@@ -116,6 +124,7 @@ def test_bench_stream_section_contract(tmp_path):
     assert rec["peak_rss_mb"]["stream"] > 0
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_bench_score_section_contract(tmp_path):
     """`--section score` keeps the budget/JSON-last-line contract and
     records the streaming-fused-scoring measurement (ISSUE 4): per-arm
@@ -146,6 +155,7 @@ def test_bench_score_section_contract(tmp_path):
     assert rec["peak_rss_mb"]["score"] > 0
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_bench_re_section_contract(tmp_path):
     """`--section re` keeps the budget/JSON-last-line contract and
     records the out-of-core random-effect measurement (ISSUE 5):

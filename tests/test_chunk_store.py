@@ -465,3 +465,50 @@ def test_mmap_npz_roundtrip(tmp_path):
     import json
 
     assert json.loads(bytes(np.asarray(out["__meta__"])))["hello"] == 1
+
+
+@pytest.mark.fast
+def test_prefetcher_error_delivered_in_band():
+    """A producer-thread failure surfaces at the consumer's ``next()``
+    as the original exception.  Since ISSUE 6 the error RIDES THE QUEUE
+    (sentinel item) instead of a shared attribute — the lint
+    unlocked-shared-write fix — so delivery needs no lock and cannot
+    race the consumer."""
+    from photon_ml_tpu.optim.streaming import ChunkPrefetcher
+
+    def load(i):
+        if i == 2:
+            raise OSError("disk went away")
+        return np.full(4, i, np.float32)
+
+    pf = ChunkPrefetcher(load, lambda h: h, depth=2)
+    pf.start(range(4))
+    try:
+        assert pf.next(0)[0] == 0
+        assert pf.next(1)[0] == 1
+        with pytest.raises(OSError, match="disk went away"):
+            pf.next(2)
+    finally:
+        pf.close()
+
+
+@pytest.mark.fast
+def test_prefetch_stream_error_and_cleanup(tmp_path):
+    """Same contract through the generator wrapper: the error raises at
+    the failing chunk and the store reader count still drains to zero
+    (quiescence is structural)."""
+    from photon_ml_tpu.data.chunk_store import ChunkStore
+    from photon_ml_tpu.optim.streaming import prefetch_stream
+
+    store = ChunkStore(str(tmp_path), "k", n_chunks=3)
+
+    def load(i):
+        if i == 1:
+            raise ValueError("bad chunk")
+        return i
+
+    with pytest.raises(ValueError, match="bad chunk"):
+        for _i, _c in prefetch_stream(load, lambda h: h, range(3),
+                                      depth=2, store=store):
+            pass
+    store.assert_quiesced()   # reader released despite the error

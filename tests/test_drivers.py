@@ -200,6 +200,7 @@ def test_training_and_scoring_drivers_libsvm(tmp_path):
     )
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_training_and_scoring_drivers_game_jsonl(tmp_path):
     """BASELINE config-4 class: fixed + per-user RE from JSONL files."""
     train_path = str(tmp_path / "train.jsonl")
@@ -319,6 +320,7 @@ def test_training_driver_validation_split_and_grid(tmp_path):
     assert summary["best_index"] != 0
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_scoring_unseen_entities_and_oov_features(tmp_path):
     """Cold-start: unknown entity ids score 0 from the RE coordinate;
     out-of-vocabulary LIBSVM feature indices are dropped, not dotted."""

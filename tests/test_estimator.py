@@ -69,6 +69,7 @@ def _config(**over):
     return TrainingConfig(**base)
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_estimator_fit_grid_and_selection(tmp_path):
     data = make_movielens_like(n_users=100, n_items=1, n_obs=5000, seed=3)
     train, valid = _split(data, 4000)
@@ -96,6 +97,7 @@ def test_estimator_fit_grid_and_selection(tmp_path):
                                atol=1e-6)
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_estimator_with_intercept_and_standardization():
     from photon_ml_tpu.data.normalization import NormalizationType
 
@@ -186,6 +188,7 @@ def test_grid_points_share_one_compilation():
     assert added <= 1, f"grid retraced the solve {added} times"
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_per_iteration_validation_history():
     """Round-3 verdict #3: one validation entry (every evaluator) per
     CD sweep through GameEstimator.fit, ending at the final model's

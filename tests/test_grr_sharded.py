@@ -135,6 +135,7 @@ def test_pooled_overflow_absorbs_spill(rng):
                                atol=5e-4)
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_shard_sparse_batch_grr_objective_equivalence(rng):
     """Assembled GRR-sharded batch through the psum objective == the
     single-device GRR objective (value, gradient, Hdiag, margins)."""
@@ -276,6 +277,7 @@ def test_sharded_mid_cap_seeded_from_heaviest_shard(rng):
                                atol=5e-4)
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_sharded_pairs_multiwindow_zipf(rng):
     """Round-4 verdict weak #5: the sharded suite topped out below one
     table window per direction (d=600, per-shard rows=128), so the
@@ -317,6 +319,7 @@ def test_sharded_pairs_multiwindow_zipf(rng):
         assert [lf.shape for lf in leaves] == s0
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_sharded_pairs_col_range_split(rng):
     """Round-5: the column-range split engages on sharded builds too —
     same ranges on every shard (pooled sample), per-range caps common,

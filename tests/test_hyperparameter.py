@@ -114,6 +114,7 @@ def _objective(cfg: dict) -> float:
     return float(-((lx - 0.5) ** 2))
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_gp_search_converges_to_optimum():
     space = SearchSpace([ParamRange("x", 1e-3, 1e3, ParamScale.LOG)])
     tuner = HyperparameterTuner(space, mode=TunerMode.BAYESIAN, seed=3)

@@ -119,6 +119,7 @@ def test_config1_tron_mesh_matches_single_device(rng):
     np.testing.assert_allclose(w8, w1, rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.slow   # 10s+ in tests/tier1_durations.json
 def test_config4_game_mesh_matches_single_device(rng):
     """BASELINE config-4 shape (fixed + per-user random effect) through
     the estimator on the mesh: entity-sharded RE solves + sharded fixed

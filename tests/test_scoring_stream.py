@@ -403,3 +403,55 @@ def test_score_random_routes_large_inputs_to_device(rng, monkeypatch):
     routed = gt._score_random(model, ids, ds)
     assert calls == [1]
     np.testing.assert_allclose(routed, host, atol=1e-5)
+
+
+def test_sink_writer_error_propagates(rng, tmp_path):
+    """A sink failure on the writer thread surfaces to the caller (at
+    put() mid-stream or at close) and aborts the remaining sinks.  The
+    cross-thread error handoff is lock-guarded since ISSUE 6
+    (photon-lint unlocked-shared-write on _SinkWriter._error)."""
+    model, ds = _mixed_workload(rng, n=600)
+
+    class ExplodingSink:
+        def __init__(self):
+            self.aborted = False
+
+        def write(self, lo, hi, margins, preds, labels, ids=None):
+            raise IOError("sink full")
+
+        def close(self):
+            raise AssertionError("close must not follow a failed write")
+
+        def abort(self):
+            self.aborted = True
+
+    sink = ExplodingSink()
+    scorer = StreamingGameScorer(model, TaskType.LOGISTIC_REGRESSION,
+                                 chunk_rows=100)
+    with pytest.raises(IOError, match="sink full"):
+        scorer.score(ds, sinks=[sink])
+    assert sink.aborted
+
+
+def test_scorer_compile_budget(rng):
+    """Guard budget (ISSUE 6): the fused per-chunk program compiles
+    once per model STRUCTURE — scoring 2x the data (more chunks, a
+    fresh dataset and plan) compiles ZERO new programs, as does
+    re-scoring the same dataset warm."""
+    from photon_ml_tpu.analysis.guards import count_compiles
+
+    model, ds1 = _mixed_workload(rng, n=700)
+    _model2, ds2 = _mixed_workload(rng, n=1400)
+    scorer = StreamingGameScorer(model, TaskType.LOGISTIC_REGRESSION,
+                                 chunk_rows=96)
+    with count_compiles() as cold:
+        scorer.score(ds1, keep_margins=True)
+    assert any("_run_chunk" in p for p in cold.programs), cold.programs
+
+    with count_compiles() as more_data:
+        scorer.score(ds2, keep_margins=True)   # same model, 2x chunks
+    assert more_data.count == 0, more_data.programs
+
+    with count_compiles() as warm:
+        scorer.score(ds1, keep_margins=True)
+    assert warm.count == 0, warm.programs
