@@ -748,6 +748,27 @@ def section_sweep(ctx: BenchContext) -> None:
           f"{per_step_s:.1f} -> {per_step_b:.1f}", file=sys.stderr)
 
 
+def _telemetry_block(summary: dict, sweeps_key: str = "solver.sweeps") -> dict:
+    """The bench-facing slice of a telemetry summary (ISSUE 7): the
+    overlap/stall derivations plus the pinned counters, embedded in
+    each arm's JSON record so a section's last line carries the
+    pipeline story alongside the wall-clock one."""
+    c = summary.get("counters", {})
+    d = summary.get("derived", {})
+    return {
+        "overlap_efficiency": d.get("overlap_efficiency"),
+        "consumer_blocked_fraction": d.get("consumer_blocked_fraction"),
+        "producer_stall_fraction": d.get("producer_stall_fraction"),
+        "consumer_wait_s": round(c.get("prefetch.consumer_wait_s", 0.0), 3),
+        "producer_stall_s": round(c.get("prefetch.producer_stall_s", 0.0), 3),
+        "pass_span_total_s": d.get("pass_span_total_s"),
+        "sweeps": c.get(sweeps_key, 0),
+        "store_hits": c.get("store.hits", 0),
+        "store_loads": c.get("store.loads", 0),
+        "compiles": c.get("jax.compiles", 0),
+    }
+
+
 def stream_arm_main(args) -> int:
     """One arm of the ``stream`` section, run in its OWN process
     (``bench.py --stream-arm spilled|resident``): a shared process
@@ -810,6 +831,14 @@ def stream_arm_main(args) -> int:
     # and no implicit host<->device transfers in the per-chunk dispatch
     # loop (transfer_guard 'log': reported, not fatal — on the CPU
     # backend the guard is structurally silent, host == device).
+    # Telemetry over the TIMED sweeps only (metrics mode): the arm's
+    # JSON gains the prefetcher overlap-efficiency block — how much of
+    # the disk+staging tier the pipeline hid under device compute.
+    # Started BEFORE the guard contexts and closed after they exit, so
+    # the two jax.log_compiles scopes nest properly.
+    from photon_ml_tpu import telemetry
+
+    tel = telemetry.start("metrics")
     guard_stack = ExitStack()
     compile_log = None
     if args.guards:
@@ -828,6 +857,8 @@ def stream_arm_main(args) -> int:
             g = cobj.value_and_gradient(w0)[1]
             jax.block_until_ready(g)
             times.append(time.time() - t0)
+    tel_summary = tel.summary()
+    tel.close()
     # Median, not mean: single passes on a small shared host jitter
     # ±20% and one descheduled pass would swing the cross-arm ratio.
     pass_s = float(np.median(times))
@@ -858,6 +889,7 @@ def stream_arm_main(args) -> int:
         "anon_delta_mb": (round(anon - base_anon_mb, 1)
                           if anon is not None
                           and base_anon_mb is not None else None),
+        "telemetry": _telemetry_block(tel_summary),
     }
     if compile_log is not None:
         rec["guards"] = {
@@ -1054,11 +1086,18 @@ def score_arm_main(args) -> int:
     margins = one_pass()             # warm: compile + (streamed) spill
     etl_s = time.time() - t0
     times = []
+    # Telemetry (metrics) over the timed passes: the streamed arm's
+    # JSON gains the prefetcher overlap block (ISSUE 7).
+    from photon_ml_tpu import telemetry
+
+    tel = telemetry.start("metrics")
     with _RssSampler() as rss:
         for _ in range(SCORE_PASSES):
             t0 = time.time()
             margins = one_pass()
             times.append(time.time() - t0)
+    tel_summary = tel.summary()
+    tel.close()
     pass_s = float(np.median(times))
     np.save(os.path.join(args.cache_dir, f"score_margins_{arm}.npy"),
             np.asarray(margins))
@@ -1078,6 +1117,8 @@ def score_arm_main(args) -> int:
         "anon_delta_mb": (round(anon - base_anon_mb, 1)
                           if anon is not None
                           and base_anon_mb is not None else None),
+        "telemetry": _telemetry_block(tel_summary,
+                                      sweeps_key="score.passes"),
     }
     if arm == "streamed":
         # The ACTUAL chunk count from the scorer (ceil rounding can
@@ -1271,11 +1312,18 @@ def re_arm_main(args) -> int:
     # per-bucket XLA compiles, whose allocator spike would set BOTH
     # arms' high-water and mask the training-regime residency
     # difference this section exists to measure (the round-8 stream
-    # section's rule).
+    # section's rule).  It also runs outside the telemetry window, so
+    # the overlap numbers describe the steady state, not the compile
+    # sweep.
     sweep(0)
+    from photon_ml_tpu import telemetry
+
+    tel = telemetry.start("metrics")
     with _RssSampler() as rss:
         for s in range(1, RE_SWEEPS):
             sweep(s)
+    tel_summary = tel.summary()
+    tel.close()
     # Sweep 0 pays the per-bucket XLA compiles; the steady-state number
     # is the median of the remaining sweeps.
     sweep_s = float(np.median(times[1:])) if len(times) > 1 else times[0]
@@ -1304,6 +1352,8 @@ def re_arm_main(args) -> int:
         "anon_delta_mb": (round(anon - base_anon_mb, 1)
                           if anon is not None
                           and base_anon_mb is not None else None),
+        "telemetry": _telemetry_block(tel_summary,
+                                      sweeps_key="re.sweeps"),
     }
     if arm == "streamed":
         store = coord.store

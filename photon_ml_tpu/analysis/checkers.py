@@ -84,6 +84,10 @@ RULES = {
         "raw os.environ read outside config.py's sanctioned registry "
         "(use photon_ml_tpu.config.read_env)"
     ),
+    "naked-clock": (
+        "time.time() used in duration arithmetic; wall clock steps "
+        "under NTP/suspend — use time.monotonic()/time.perf_counter()"
+    ),
     "slow-unmarked": (
         "test measured slower than the threshold lacks "
         "@pytest.mark.slow"
@@ -753,6 +757,80 @@ def check_env_read(ctx: _FileContext):
 
 
 # ---------------------------------------------------------------------------
+# Rule: naked-clock
+# ---------------------------------------------------------------------------
+
+_WALL_CLOCKS = ("time.time",)
+
+
+def _calls_wall_clock(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call)
+               and _dotted(n.func) in _WALL_CLOCKS
+               for n in ast.walk(node))
+
+
+def check_naked_clock(ctx: _FileContext):
+    """Durations must come from a monotonic clock.
+
+    ``time.time()`` is the wall clock: it steps under NTP adjustment
+    and suspend/resume, so ``time.time() - t0`` can go negative or jump
+    by seconds — every phase timer, bench number, and telemetry span in
+    the repo uses ``monotonic``/``perf_counter`` instead (the ISSUE-7
+    telemetry tier made timing a first-class output, so a wall-clock
+    duration is now a data-corruption bug, not just jitter).  Flags
+    subtractions where either operand is a direct ``time.time()`` call
+    or a name assigned from one; epoch TIMESTAMPS (no subtraction) stay
+    legal, and deliberate wall-clock math can carry a waiver."""
+    def _scope(node: ast.AST):
+        """Nearest enclosing function (None = module scope) — plain
+        names are tainted PER FUNCTION, so `t0 = time.time()` in one
+        function cannot flag another function's perf_counter `t0`
+        subtraction (reuse of conventional names is the norm)."""
+        for anc in _ancestors(node, ctx.parents):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return anc
+        return None
+
+    clock_names: dict = {}         # scope id -> set of tainted names
+    attr_names: set[str] = set()   # self.<attr> taint is class/file-wide
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            if _dotted(node.value.func) in _WALL_CLOCKS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        clock_names.setdefault(
+                            id(_scope(node)), set()).add(t.id)
+                    else:
+                        attr = _self_attr(t)
+                        if attr:
+                            attr_names.add(attr)
+
+    def tainted(side: ast.AST, scoped: set[str]) -> bool:
+        if _calls_wall_clock(side):
+            return True
+        for n in ast.walk(side):
+            if (isinstance(n, ast.Name) and n.id in scoped
+                    and isinstance(n.ctx, ast.Load)):
+                return True
+            attr = _self_attr(n)
+            if attr is not None and attr in attr_names:
+                return True
+        return False
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            scoped = clock_names.get(id(_scope(node)), set())
+            if tainted(node.left, scoped) or tainted(node.right, scoped):
+                yield Violation(
+                    ctx.path, node.lineno, "naked-clock",
+                    "duration arithmetic on time.time(): the wall "
+                    "clock steps under NTP/suspend; use "
+                    "time.monotonic() or time.perf_counter()")
+
+
+# ---------------------------------------------------------------------------
 # Rule: slow-unmarked (repo-level: needs the recorded durations)
 # ---------------------------------------------------------------------------
 
@@ -839,6 +917,7 @@ _FILE_CHECKERS = (
     check_thread_discipline,
     check_accumulator_dtype,
     check_env_read,
+    check_naked_clock,
 )
 
 

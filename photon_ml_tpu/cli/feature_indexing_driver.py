@@ -30,25 +30,32 @@ from photon_ml_tpu.utils.run_log import RunLogger
 def run(input_path: str, output_dir: str,
         shards: list[str] | None = None,
         entity_keys: list[str] | None = None,
-        log: RunLogger | None = None) -> dict:
+        log: RunLogger | None = None,
+        telemetry_mode: str = "off") -> dict:
     # Indexing itself is host-only, but wire the compilation cache
     # like the other drivers so $PHOTON_ML_TPU_COMPILE_CACHE covers any
     # jax use behind the I/O layer uniformly.
+    from photon_ml_tpu import telemetry
     from photon_ml_tpu.cache import enable_compilation_cache
 
     enable_compilation_cache()
-    log = log or RunLogger()
-    with log.timed("build_index_maps", input=input_path):
-        feature_maps, entity_maps = build_index_maps(
-            input_path, shards, entity_keys
-        )
-    save_index_maps(output_dir, feature_maps, entity_maps)
-    sizes = {
-        "features": {s: len(m) for s, m in feature_maps.items()},
-        "entities": {k: len(m) for k, m in entity_maps.items()},
-    }
-    log.event("index_maps_written", output_dir=output_dir, **sizes)
-    return sizes
+    # Context-managed logger + optional telemetry session (the driver
+    # knob discipline of the other two drivers): the scan phase becomes
+    # a span and the summary/trace land under the output dir.
+    with (log or RunLogger()) as log, \
+            telemetry.maybe_session(telemetry_mode, output_dir,
+                                    run_logger=log):
+        with log.timed("build_index_maps", input=input_path):
+            feature_maps, entity_maps = build_index_maps(
+                input_path, shards, entity_keys
+            )
+        save_index_maps(output_dir, feature_maps, entity_maps)
+        sizes = {
+            "features": {s: len(m) for s, m in feature_maps.items()},
+            "entities": {k: len(m) for k, m in entity_maps.items()},
+        }
+        log.event("index_maps_written", output_dir=output_dir, **sizes)
+        return sizes
 
 
 def main(argv: list[str] | None = None) -> dict:
@@ -61,8 +68,14 @@ def main(argv: list[str] | None = None) -> dict:
                         help="feature shards to index (default: all)")
     parser.add_argument("--entity-keys", nargs="*", default=None,
                         help="entity id keys to index (default: all)")
+    parser.add_argument("--telemetry",
+                        choices=("off", "metrics", "trace"),
+                        default="off",
+                        help="pipeline telemetry for the scan phase "
+                             "(summary/trace land in --output-dir)")
     args = parser.parse_args(argv)
-    return run(args.input, args.output_dir, args.shards, args.entity_keys)
+    return run(args.input, args.output_dir, args.shards,
+               args.entity_keys, telemetry_mode=args.telemetry)
 
 
 if __name__ == "__main__":

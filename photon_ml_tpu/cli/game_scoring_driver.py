@@ -130,12 +130,17 @@ def run(config: ScoringConfig, log: RunLogger | None = None) -> dict:
     config.validate()
     out_dir = os.path.dirname(os.path.abspath(config.output_path))
     os.makedirs(out_dir, exist_ok=True)
-    if log is None:
-        log = RunLogger(os.path.join(out_dir, "scoring_log.jsonl"))
-    try:
+    from photon_ml_tpu import telemetry
+
+    # Context-managed logger lifecycle + shared telemetry session (see
+    # the training driver): spans/heartbeats land in scoring_log.jsonl,
+    # trace.json (telemetry=trace) in telemetry_dir.
+    with (log or RunLogger(os.path.join(out_dir,
+                                        "scoring_log.jsonl"))) as log, \
+            telemetry.maybe_session(
+                config.telemetry, config.telemetry_dir or out_dir,
+                run_logger=log):
         return _run(config, log)
-    finally:
-        log.close()
 
 
 def _run_streamed(config: ScoringConfig, model, task, data,
@@ -239,10 +244,19 @@ def main(argv: list[str] | None = None) -> dict:
     parser.add_argument("--prefetch-depth", type=int, default=None,
                         help="override: background prefetch depth "
                              "(0 = synchronous)")
+    parser.add_argument("--telemetry", choices=("off", "metrics", "trace"),
+                        default=None,
+                        help="override config telemetry: pipeline "
+                             "spans/metrics (metrics) + Chrome "
+                             "trace.json export (trace); analyze with "
+                             "python -m photon_ml_tpu.telemetry report")
+    parser.add_argument("--telemetry-dir", default=None,
+                        help="override config telemetry_dir (default: "
+                             "the output file's directory)")
     args = parser.parse_args(argv)
     config = load_scoring_config(args.config)
     for name in ("score_chunk_rows", "spill_dir", "host_max_resident",
-                 "prefetch_depth"):
+                 "prefetch_depth", "telemetry", "telemetry_dir"):
         val = getattr(args, name)
         if val is not None:
             setattr(config, name, val)

@@ -178,12 +178,19 @@ def run(config: TrainingConfig, log: RunLogger | None = None) -> dict:
     if config.distributed_init:
         distributed_init_from_env()
     os.makedirs(config.output_dir, exist_ok=True)
-    if log is None:
-        log = RunLogger(os.path.join(config.output_dir, "run_log.jsonl"))
-    try:
+    from photon_ml_tpu import telemetry
+
+    # Context-managed logger lifecycle (ISSUE 7 satellite: the handle
+    # used to leak on paths that bypassed close); the telemetry session
+    # shares the logger so spans/heartbeats land in the same JSONL the
+    # report CLI reads.
+    with (log or RunLogger(os.path.join(config.output_dir,
+                                        "run_log.jsonl"))) as log, \
+            telemetry.maybe_session(
+                config.telemetry,
+                config.telemetry_dir or config.output_dir,
+                run_logger=log):
         return _run(config, log)
-    finally:
-        log.close()
 
 
 def _run(config: TrainingConfig, log: RunLogger) -> dict:
@@ -268,6 +275,15 @@ def main(argv: list[str] | None = None) -> dict:
                         help="override config re_retirement: freeze "
                              "converged entities between CD sweeps "
                              "(streamed random effects only)")
+    parser.add_argument("--telemetry", choices=("off", "metrics", "trace"),
+                        default=None,
+                        help="override config telemetry: pipeline "
+                             "spans/metrics (metrics) + Chrome "
+                             "trace.json export (trace); analyze with "
+                             "python -m photon_ml_tpu.telemetry report")
+    parser.add_argument("--telemetry-dir", default=None,
+                        help="override config telemetry_dir (default: "
+                             "the output dir)")
     args = parser.parse_args(argv)
     config = load_training_config(args.config)
     if args.output_dir:
@@ -282,6 +298,10 @@ def main(argv: list[str] | None = None) -> dict:
         config.re_chunk_entities = args.re_chunk_entities
     if args.re_retirement is not None:
         config.re_retirement = args.re_retirement == "on"
+    if args.telemetry is not None:
+        config.telemetry = args.telemetry
+    if args.telemetry_dir is not None:
+        config.telemetry_dir = args.telemetry_dir
     # Re-validate with the overrides applied (the spill/streamed-RE
     # cross-field rules must hold for the effective config).
     config.validate()

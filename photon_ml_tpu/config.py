@@ -296,6 +296,17 @@ class TrainingConfig:
     # When set, the driver's fit phase runs under jax.profiler.trace
     # and a TensorBoard/XProf device trace is written here (SURVEY §5.1).
     profile_dir: str | None = None
+    # Pipeline telemetry (photon_ml_tpu.telemetry, ISSUE 7):
+    # "off" (default) = the no-op singleton — zero events, zero extra
+    # compiles, no measurable pass-time overhead; "metrics" = counters/
+    # gauges/histograms + per-name span duration stats (the
+    # telemetry_summary event); "trace" = metrics plus full span
+    # retention, per-span run-log events, and a Chrome trace-event
+    # trace.json (Perfetto-loadable) in telemetry_dir.  telemetry_dir
+    # defaults to output_dir.  Analyze with
+    # `python -m photon_ml_tpu.telemetry report <run_log.jsonl>`.
+    telemetry: str = "off"
+    telemetry_dir: str | None = None
     # Multi-host scale-out (SURVEY §5.8/§7 stage 9): when true, the
     # training driver calls jax.distributed.initialize() before any
     # backend use (coordinator/process env read from the standard JAX
@@ -335,6 +346,8 @@ class TrainingConfig:
             raise ValueError("model_output_mode must be ALL|BEST|EXPLICIT")
         if self.sparse_layout not in ("AUTO", "GRR", "COLMAJOR", "ELL"):
             raise ValueError("sparse_layout must be AUTO|GRR|COLMAJOR|ELL")
+        if self.telemetry not in ("off", "metrics", "trace"):
+            raise ValueError("telemetry must be off|metrics|trace")
         if self.chunk_layout not in ("AUTO", "GRR", "ELL"):
             raise ValueError("chunk_layout must be AUTO|GRR|ELL")
         if self.host_max_resident < 1:
@@ -437,10 +450,16 @@ class ScoringConfig:
     spill_dir: str | None = None
     host_max_resident: int = 2
     prefetch_depth: int = 2
+    # Pipeline telemetry (see TrainingConfig.telemetry): off | metrics
+    # | trace; telemetry_dir defaults to the output file's directory.
+    telemetry: str = "off"
+    telemetry_dir: str | None = None
 
     def validate(self) -> None:
         if self.score_chunk_rows is not None and self.score_chunk_rows <= 0:
             raise ValueError("score_chunk_rows must be positive")
+        if self.telemetry not in ("off", "metrics", "trace"):
+            raise ValueError("telemetry must be off|metrics|trace")
         if self.host_max_resident < 1:
             raise ValueError("host_max_resident must be >= 1")
         if self.prefetch_depth < 0:

@@ -56,6 +56,8 @@ from collections import OrderedDict
 
 import numpy as np
 
+from photon_ml_tpu import telemetry
+
 logger = logging.getLogger(__name__)
 
 # On-disk chunk format version: bump when the member layout changes —
@@ -445,6 +447,12 @@ class ChunkStore:
             # ``put`` runs on the build thread AND (rebuild re-spill)
             # the prefetch thread — the counter is shared state.
             self.spills += 1
+        telemetry.count("store.spills")
+        try:
+            telemetry.count("store.bytes_spilled",
+                            os.path.getsize(self.path(i)))
+        except OSError:      # racing cleanup: the metric is best-effort
+            pass
         if keep_resident is None:
             keep_resident = i < self.host_max_resident
         if keep_resident:
@@ -458,7 +466,9 @@ class ChunkStore:
                 self._resident.move_to_end(i)
                 self.hits += 1
                 self.access_log.append(i)
-                return self._resident[i]
+                hit = self._resident[i]
+                telemetry.count("store.hits")
+                return hit
         chunk = self._load(i)
         self._admit(i, chunk)
         return chunk
@@ -468,13 +478,21 @@ class ChunkStore:
         with self._lock:
             self.access_log.append(i)
             self.loads += 1
+        telemetry.count("store.loads")
         try:
             try:
                 arrays = _open_npz_mmap(path)
+                telemetry.count("store.mmap_loads")
             except (zipfile.BadZipFile, ValueError, OSError):
                 # mmap parse surprise: fall back to a copying load
                 # before declaring the file dead.
                 arrays = dict(np.load(path, allow_pickle=False))
+                telemetry.count("store.copy_loads")
+            try:
+                telemetry.count("store.bytes_read",
+                                os.path.getsize(path))
+            except OSError:
+                pass
             meta = json.loads(bytes(np.asarray(arrays["__meta__"]))
                               .decode())
             return self._decode(meta, arrays)
@@ -486,6 +504,7 @@ class ChunkStore:
                 path, e)
             with self._lock:
                 self.rebuilds += 1
+            telemetry.count("store.rebuilds")
             chunk = self._rebuild(i)
             try:
                 self.put(i, chunk, keep_resident=False)

@@ -22,6 +22,7 @@ TPU translation notes:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
 import logging
@@ -746,6 +747,8 @@ class GameEstimator:
             W = jnp.tile(jnp.asarray(base_w0)[None, :], (L, 1))
         else:
             W = None
+        from photon_ml_tpu import telemetry
+
         t0 = _time.perf_counter()
         res = None
         inv_idx = jnp.asarray(inv)
@@ -756,15 +759,19 @@ class GameEstimator:
         validate = (validation is not None and cfg.validate_per_iteration)
         lane_history: list[list] = [[] for _ in range(L)]
         for _ in range(cfg.n_iterations):
-            W, res = coord.train_swept(offsets, reg, warm_start=W)
+            with telemetry.span("swept_train", cat="train",
+                                coordinate=name, lanes=L):
+                W, res = coord.train_swept(offsets, reg, warm_start=W)
             if validate:
-                W_now = W[inv_idx]
-                for j in range(L):
-                    snap = self._swept_lane_model(
-                        coords, name, W_now[j], locked, offsets,
-                        float(lams[j]), with_variances=False)
-                    lane_history[j].append(
-                        self._evaluate(snap, validation))
+                with telemetry.span("swept_validation", cat="train",
+                                    coordinate=name, lanes=L):
+                    W_now = W[inv_idx]
+                    for j in range(L):
+                        snap = self._swept_lane_model(
+                            coords, name, W_now[j], locked, offsets,
+                            float(lams[j]), with_variances=False)
+                        lane_history[j].append(
+                            self._evaluate(snap, validation))
         elapsed = _time.perf_counter() - t0
         logger.info("swept fit: %d λ-lanes of '%s' in %.2fs", L, name,
                     elapsed)
@@ -932,30 +939,65 @@ class GameEstimator:
         full fit; other shapes fit once per grid point."""
         # Programmatic callers (no driver) still get the warm compile
         # path from config; no-op when neither config nor env sets it.
+        from photon_ml_tpu import telemetry
         from photon_ml_tpu.cache import enable_compilation_cache
 
         enable_compilation_cache(self.config.compilation_cache_dir)
-        prep = self._prepare(train)
-        grid_points = self._grid_points()
-        name = self._swept_coordinate_name()
-        if (len(grid_points) > 1 and name is not None
-                and set(self.config.reg_weight_grid) == {name}
-                and not self.config.checkpoint_dir):
-            return self._fit_grid_swept(train, prep, name, grid_points,
-                                        validation, run_logger)
-        return [
-            self._fit_point(
-                train, prep, reg_weights, validation, run_logger,
-                ckpt_tag=(f"grid_{gi}" if len(grid_points) > 1 else None),
-            )
-            for gi, reg_weights in enumerate(grid_points)
-        ]
+        # Telemetry honors the config knob for programmatic callers too
+        # (a driver-configured session takes precedence — maybe_session
+        # is a no-op when one is already active).  The whole grid fit
+        # is one top-level span so the report's reconciliation has a
+        # wall-clock anchor on the main thread.
+        # "estimator_fit", not "fit": the driver's timed fit phase is
+        # already a span of that name, and a same-name nested span
+        # double-counts in the report's stage table.
+        with telemetry.maybe_session(
+                self.config.telemetry,
+                self.config.telemetry_dir or self.config.output_dir,
+                run_logger=run_logger), \
+                telemetry.span("estimator_fit", cat="phase"):
+            prep = self._prepare(train)
+            grid_points = self._grid_points()
+            name = self._swept_coordinate_name()
+            if (len(grid_points) > 1 and name is not None
+                    and set(self.config.reg_weight_grid) == {name}
+                    and not self.config.checkpoint_dir):
+                return self._fit_grid_swept(train, prep, name,
+                                            grid_points, validation,
+                                            run_logger)
+            return [
+                self._fit_point(
+                    train, prep, reg_weights, validation, run_logger,
+                    ckpt_tag=(f"grid_{gi}" if len(grid_points) > 1
+                              else None),
+                )
+                for gi, reg_weights in enumerate(grid_points)
+            ]
 
     def fit_tuned(self, train: GameDataset, validation: GameDataset,
                   run_logger=None) -> list[FitResult]:
         """Bayesian/random tuning of per-coordinate reg weights
         (reference HyperparameterTuner wrapping GameEstimator.fit,
         SURVEY §3.5).  Returns one FitResult per trial, in trial order."""
+        from photon_ml_tpu import telemetry
+
+        cfg = self.config
+        tuning = cfg.tuning
+        if tuning is None:
+            raise ValueError("fit_tuned requires config.tuning")
+        if not cfg.evaluators:
+            raise ValueError("tuning needs at least one evaluator")
+        ev = cfg.evaluators[0]
+        with contextlib.ExitStack() as stack:
+            stack.enter_context(telemetry.maybe_session(
+                cfg.telemetry, cfg.telemetry_dir or cfg.output_dir,
+                run_logger=run_logger))
+            stack.enter_context(telemetry.span("fit_tuned", cat="phase"))
+            return self._fit_tuned_inner(train, validation, run_logger,
+                                         ev, tuning)
+
+    def _fit_tuned_inner(self, train, validation, run_logger, ev,
+                         tuning) -> list[FitResult]:
         from photon_ml_tpu.hyperparameter import (
             HyperparameterTuner,
             ParamRange,
@@ -965,12 +1007,6 @@ class GameEstimator:
         )
 
         cfg = self.config
-        tuning = cfg.tuning
-        if tuning is None:
-            raise ValueError("fit_tuned requires config.tuning")
-        if not cfg.evaluators:
-            raise ValueError("tuning needs at least one evaluator")
-        ev = cfg.evaluators[0]
 
         space = SearchSpace([
             ParamRange(name, r["low"], r["high"],

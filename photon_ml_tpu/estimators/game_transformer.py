@@ -267,15 +267,24 @@ class GameTransformer:
 
     def transform(self, dataset: GameDataset) -> np.ndarray:
         """Summed raw scores [n] (+ dataset offsets, reference semantics)."""
+        from photon_ml_tpu import telemetry
+
         total = dataset.offset_array().astype(np.float64).copy()
-        for name, comp in self.model.models.items():
-            if isinstance(comp, FixedEffectModel):
-                total += _score_fixed(comp, dataset)
-            elif isinstance(comp, RandomEffectModel):
-                ids = dataset.entity_ids[comp.entity_key or name]
-                total += _score_random(comp, ids, dataset)
-            else:
-                raise TypeError(f"unknown component model {type(comp)}")
+        with telemetry.span("transform", cat="score", n=int(dataset.n)):
+            for name, comp in self.model.models.items():
+                # One span per coordinate pass: the resident path walks
+                # the dataset once PER COORDINATE — the report shows
+                # which coordinate's pass dominates.
+                with telemetry.span("score_coordinate", cat="score",
+                                    coordinate=name):
+                    if isinstance(comp, FixedEffectModel):
+                        total += _score_fixed(comp, dataset)
+                    elif isinstance(comp, RandomEffectModel):
+                        ids = dataset.entity_ids[comp.entity_key or name]
+                        total += _score_random(comp, ids, dataset)
+                    else:
+                        raise TypeError(
+                            f"unknown component model {type(comp)}")
         return total.astype(np.float32)
 
     def transform_streamed(self, dataset: GameDataset,

@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.data.sparse_rows import SparseRows
 from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.models.game import (
@@ -136,18 +137,45 @@ class _SinkWriter:
         with self._lock:
             return self._error
 
+    def _next_item(self):
+        """Queue pop; with telemetry active, polls with liveness
+        heartbeats so a starved (or hung-upstream) writer thread is
+        visible in the run log."""
+        t = telemetry.active()
+        if t is None:
+            return self._q.get()
+        start = time.perf_counter()
+        beat = start
+        while True:
+            try:
+                return self._q.get(timeout=0.05)
+            except queue.Empty:
+                now = time.perf_counter()
+                if now - beat >= t.heartbeat_s:
+                    t.heartbeat("sink-writer", state="queue_empty",
+                                waiting_s=round(now - start, 3))
+                    beat = now
+
     def _run(self) -> None:
         while True:
-            item = self._q.get()
+            item = self._next_item()
             if item is self._SENTINEL:
                 return
             if self._failed() is not None:
                 continue       # drain without writing after a failure
             try:
                 lo, hi, margins, preds, labels, ids = item
-                for s in self._sinks:
-                    s.write(lo, hi, margins, preds, labels, ids=ids)
+                t0 = time.perf_counter()
+                with telemetry.span("sink_write", cat="sink",
+                                    lo=lo, hi=hi):
+                    for s in self._sinks:
+                        s.write(lo, hi, margins, preds, labels, ids=ids)
+                telemetry.observe("sink.write_s",
+                                  time.perf_counter() - t0)
             except BaseException as e:
+                # Death event first (hung-run forensics), then the
+                # locked error hand-off the producer reads in put().
+                telemetry.thread_exception("sink-writer", e)
                 with self._lock:
                     self._error = e
 
@@ -155,6 +183,7 @@ class _SinkWriter:
         err = self._failed()
         if err is not None:
             raise err
+        telemetry.gauge("sink.queue_depth", self._q.qsize())
         self._q.put((lo, hi, margins, preds, labels, ids))
 
     def close(self) -> None:
@@ -429,19 +458,25 @@ class StreamingGameScorer:
             i, m_dev, p_dev = item
             lo = i * R
             hi = min(lo + R, n)
-            # Planned D2H harvest spelled explicitly (device_get) so the
-            # chunk loop stays clean under guards.no_implicit_transfers.
-            m = jax.device_get(m_dev)[: hi - lo]
-            p = jax.device_get(p_dev)[: hi - lo]
-            lab = labels[lo:hi]
-            for ev in evaluators:
-                ev.update(m, p, lab, weights[lo:hi])
-            if writer is not None:
-                writer.put(lo, hi, m, p, lab,
-                           {k: v[lo:hi] for k, v in entity_cols.items()})
-            if keep_margins:
-                margins_out[lo:hi] = m
-                preds_out[lo:hi] = p
+            t0 = time.perf_counter()
+            with telemetry.span("chunk_drain", cat="score", chunk=i):
+                # Planned D2H harvest spelled explicitly (device_get) so
+                # the chunk loop stays clean under
+                # guards.no_implicit_transfers.
+                m = jax.device_get(m_dev)[: hi - lo]
+                p = jax.device_get(p_dev)[: hi - lo]
+                lab = labels[lo:hi]
+                for ev in evaluators:
+                    ev.update(m, p, lab, weights[lo:hi])
+                if writer is not None:
+                    writer.put(lo, hi, m, p, lab,
+                               {k: v[lo:hi]
+                                for k, v in entity_cols.items()})
+                if keep_margins:
+                    margins_out[lo:hi] = m
+                    preds_out[lo:hi] = p
+            telemetry.observe("score.chunk_drain_s",
+                              time.perf_counter() - t0)
 
         def placed_chunks():
             """Device chunks in order, prefetched (build/disk-read +
@@ -459,33 +494,42 @@ class StreamingGameScorer:
                 for i in range(n_chunks):
                     yield jax.device_put(load(i))
 
-        t0 = time.time()
+        # perf_counter, not time.time: the difference below is DURATION
+        # arithmetic (the photon-lint naked-clock rule — wall clock
+        # steps under NTP adjustment).
+        t0 = time.perf_counter()
         pending: list = []
         try:
-            for i, buf in enumerate(placed_chunks()):
-                if pending:
-                    # Lag-2 dispatch backpressure (the round-8 rule):
-                    # the previous chunk's margins are fenced before
-                    # this chunk dispatches, so the async queue holds
-                    # ~two chunks' buffers, not all K.  D2H copies of
-                    # drained chunks keep overlapping regardless.
-                    jax.block_until_ready(pending[-1][1])
-                m, p = run(tables, buf)
-                for out in (m, p):
-                    try:
-                        out.copy_to_host_async()
-                    except AttributeError:
-                        pass
-                pending.append((i, m, p))
-                if len(pending) > _INFLIGHT:
-                    drain(pending.pop(0))
-            for item in pending:
-                drain(item)
-            if writer is not None:
-                writer.close()
-                writer = None
-            for s in sinks:
-                s.close()
+            with telemetry.span("score_pass", cat="score",
+                                chunks=n_chunks):
+                telemetry.count("score.passes")
+                for i, buf in enumerate(placed_chunks()):
+                    with telemetry.span("chunk_compute", cat="device"):
+                        if pending:
+                            # Lag-2 dispatch backpressure (the round-8
+                            # rule): the previous chunk's margins are
+                            # fenced before this chunk dispatches, so
+                            # the async queue holds ~two chunks'
+                            # buffers, not all K.  D2H copies of
+                            # drained chunks keep overlapping
+                            # regardless.
+                            jax.block_until_ready(pending[-1][1])
+                        m, p = run(tables, buf)
+                    for out in (m, p):
+                        try:
+                            out.copy_to_host_async()
+                        except AttributeError:
+                            pass
+                    pending.append((i, m, p))
+                    if len(pending) > _INFLIGHT:
+                        drain(pending.pop(0))
+                for item in pending:
+                    drain(item)
+                if writer is not None:
+                    writer.close()
+                    writer = None
+                for s in sinks:
+                    s.close()
         except BaseException:
             if writer is not None:
                 try:
@@ -498,7 +542,7 @@ class StreamingGameScorer:
                 except BaseException:
                     pass
             raise
-        wall_s = time.time() - t0
+        wall_s = time.perf_counter() - t0
 
         result = {
             "n": int(n),

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.game.coordinates import Coordinate
 
 logger = logging.getLogger(__name__)
@@ -230,17 +231,24 @@ def run_coordinate_descent(
                 continue
             coord = coordinates[name]
             t0 = time.perf_counter()
-            offsets = total - scores[name]
-            # The warm-start buffer is rebound to the result right
-            # below, so let XLA write the new coefficients into the old
-            # buffer (donation; SURVEY §5.2).  NOTE: on the first sweep
-            # this consumes the caller's initial_coefficients /
-            # checkpoint-restored arrays — any later read of those
-            # buffers would hit a deleted-buffer error; nothing in this
-            # loop re-reads them (coefs[name] is rebound below).
-            w, diag = coord.train(offsets, coefs.get(name),
-                                  donate_warm_start=True)
-            new_scores = coord.score(w)
+            # Per-coordinate stage span (ISSUE 7): one CD sweep's
+            # train+score for this coordinate is one block on the
+            # timeline, the unit the report's stage table attributes
+            # time to.
+            with telemetry.span("cd_coordinate", cat="cd",
+                                coordinate=name, iteration=it + 1):
+                offsets = total - scores[name]
+                # The warm-start buffer is rebound to the result right
+                # below, so let XLA write the new coefficients into the
+                # old buffer (donation; SURVEY §5.2).  NOTE: on the
+                # first sweep this consumes the caller's
+                # initial_coefficients / checkpoint-restored arrays —
+                # any later read of those buffers would hit a
+                # deleted-buffer error; nothing in this loop re-reads
+                # them (coefs[name] is rebound below).
+                w, diag = coord.train(offsets, coefs.get(name),
+                                      donate_warm_start=True)
+                new_scores = coord.score(w)
             # ``offsets`` already holds total − old scores; reusing it
             # saves one [n]-vector op per coordinate per sweep (and
             # matches the reference's residual algebra exactly).
@@ -257,6 +265,8 @@ def run_coordinate_descent(
             # entities into chunks.  Part of the Coordinate contract:
             # the base returns None (no retirement protocol).
             newly_retired = coord.retire_converged()
+            if newly_retired:
+                telemetry.count("cd.entities_retired", newly_retired)
             extra = ({} if newly_retired is None
                      else {"entities_newly_retired": newly_retired})
             logger.info(
@@ -271,7 +281,9 @@ def run_coordinate_descent(
                 )
         history.append(iter_diag)
         if validator is not None:
-            metric = _call_validator(validator, coefs, total)
+            with telemetry.span("cd_validation", cat="cd",
+                                iteration=it + 1):
+                metric = _call_validator(validator, coefs, total)
             validation_history.append(metric)
             if isinstance(metric, dict):
                 fields = {str(getattr(k, "value", k)): float(v)

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.data.batch import Batch, DenseBatch
 from photon_ml_tpu.game.dataset import (
     EntityGrouping,
@@ -989,22 +990,31 @@ class StreamedRandomEffectCoordinate(Coordinate):
         opt = self.problem
         has_l1 = opt.has_l1()
         pending = None
-        for _, item in self._stream(specs, off):
-            dev, b, ents, ex, rows, cols = item
-            out = _re_chunk_train(
-                opt.optimizer, opt.config, has_l1, opt.objective,
-                dev["x"], dev["labels"], dev["weights"], dev["mask"],
-                dev["offsets"], dev["w0"],
-            )
+        # Stage span (ISSUE 7): one streamed RE sweep — the unit the
+        # overlap-efficiency derivation divides consumer wait by.
+        with telemetry.span("re_sweep", cat="solver",
+                            coordinate=self.name, chunks=len(specs)):
+            for _, item in self._stream(specs, off):
+                dev, b, ents, ex, rows, cols = item
+                with telemetry.span("chunk_compute", cat="device",
+                                    bucket=b):
+                    out = _re_chunk_train(
+                        opt.optimizer, opt.config, has_l1, opt.objective,
+                        dev["x"], dev["labels"], dev["weights"],
+                        dev["mask"], dev["offsets"], dev["w0"],
+                    )
+                    if pending is not None:
+                        # Lag-1 harvest IS the dispatch backpressure:
+                        # fetching chunk j-1's blocks fences its solve
+                        # while chunk j computes and chunks j+1..
+                        # prefetch — at most two chunks' device buffers
+                        # are ever in flight.
+                        harvest(*pending)
+                pending = (out, b, ents, ex, rows, cols)
             if pending is not None:
-                # Lag-1 harvest IS the dispatch backpressure: fetching
-                # chunk j-1's blocks fences its solve while chunk j
-                # computes and chunks j+1.. prefetch — at most two
-                # chunks' device buffers are ever in flight.
                 harvest(*pending)
-            pending = (out, b, ents, ex, rows, cols)
-        if pending is not None:
-            harvest(*pending)
+        telemetry.count("re.sweeps")
+        telemetry.count("re.chunks_streamed", len(specs))
 
         # Retirement candidates: solved, lane-converged, coefficients
         # AND offsets both moved < tolerance this sweep.  Committed by
@@ -1029,6 +1039,7 @@ class StreamedRandomEffectCoordinate(Coordinate):
         self._last_w_blocks = list(blocks_out)
         self._cached_scores = jnp.asarray(self._scores_host)
         n_solved = int(sum(m.sum() for m in solved))
+        telemetry.count("re.entities_solved", n_solved)
         diag = {
             "entities": int(sum(ne)),
             "entities_solved": n_solved,

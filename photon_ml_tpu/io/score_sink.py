@@ -32,6 +32,7 @@ import zipfile
 
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.io.avro import MAGIC, SYNC_SIZE, Schema, write_long
 from photon_ml_tpu.io.avro_schemas import SCORING_RESULT_SCHEMA
 
@@ -63,6 +64,7 @@ class NpzScoreSink:
                                                     np.float32)
         self._mm["labels"][lo:hi] = np.asarray(labels, np.float32)
         self._written += hi - lo
+        telemetry.count("sink.rows_written", hi - lo)
 
     def close(self) -> None:
         if self._written != self.n:
@@ -186,6 +188,9 @@ class AvroScoreSink:
         self._f.write(self._sync)
         self.records_written += count
         self.blocks_written += 1
+        telemetry.count("sink.rows_written", count)
+        telemetry.count("sink.avro_blocks")
+        telemetry.count("sink.bytes_written", len(payload))
 
     def close(self) -> None:
         self._f.close()

@@ -36,12 +36,14 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.data.chunked_batch import ChunkedBatch
 from photon_ml_tpu.ops.objective import (
     GLMObjective,
@@ -135,28 +137,67 @@ class ChunkPrefetcher:
         self._thread.start()
 
     def _put(self, item) -> bool:
+        t = telemetry.active()
+        if t is None:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+        # Telemetry-on path: account full-queue stall time (a full
+        # queue means the producer is AHEAD — informational, not a
+        # problem) and emit liveness heartbeats while blocked, so a
+        # hung consumer shows as a stalled-but-alive producer.
+        start = time.perf_counter()
+        beat = start
         while not self._stop.is_set():
             try:
                 self._q.put(item, timeout=0.05)
+                stalled = time.perf_counter() - start
+                if stalled > 0.01:   # an actual full-queue wait
+                    t.count("prefetch.producer_stall_s", stalled)
                 return True
             except queue.Full:
-                continue
+                now = time.perf_counter()
+                if now - beat >= t.heartbeat_s:
+                    t.heartbeat("prefetch-producer", state="queue_full",
+                                stalled_s=round(now - start, 3))
+                    beat = now
         return False
 
     def _run(self, order) -> None:
+        t = telemetry.active()
+        last_beat = time.perf_counter()
         try:
             for i in order:
                 if self._stop.is_set():
                     return
-                host = self._load(i)                 # disk -> host
-                buf = self._place(host)              # host -> device
+                with telemetry.span("prefetch_load", cat="prefetch",
+                                    chunk=i):
+                    host = self._load(i)             # disk -> host
+                with telemetry.span("prefetch_place", cat="prefetch",
+                                    chunk=i):
+                    buf = self._place(host)          # host -> device
+                if t is not None:
+                    t.count("prefetch.chunks_produced")
+                    t.gauge("prefetch.queue_depth", self._q.qsize())
+                    now = time.perf_counter()
+                    if now - last_beat >= t.heartbeat_s:
+                        t.heartbeat("prefetch-producer", chunk=i)
+                        last_beat = now
                 if not self._put((i, host, buf)):
                     return
         except BaseException as e:
-            # The error RIDES THE QUEUE to the consumer: an attribute
-            # would be an unlocked cross-thread write (photon-lint
-            # unlocked-shared-write); the queue's internal lock gives
-            # the happens-before edge for free.
+            # Death event FIRST (hung-run forensics: the JSONL shows
+            # which stage died even if the consumer never drains the
+            # sentinel), then the error RIDES THE QUEUE to the
+            # consumer: an attribute would be an unlocked cross-thread
+            # write (photon-lint unlocked-shared-write); the queue's
+            # internal lock gives the happens-before edge for free.
+            telemetry.thread_exception("prefetch-producer", e)
+            logger.warning("chunk prefetch thread died: %r", e)
             self._put((self._SENTINEL, e, None))
         finally:
             if self._store is not None:
@@ -164,8 +205,31 @@ class ChunkPrefetcher:
 
     def next(self, expect: int):
         """The next placed chunk; raises the producer's error, and
-        asserts the deterministic order."""
-        i, host, buf = self._q.get()
+        asserts the deterministic order.  With telemetry active the
+        blocking wait is accounted (``prefetch.consumer_wait_s`` — the
+        numerator of the overlap-efficiency derivation) and heartbeats
+        flow while starved, so a hung producer shows as a waiting-but-
+        alive consumer."""
+        t = telemetry.active()
+        if t is None:
+            i, host, buf = self._q.get()
+        else:
+            start = time.perf_counter()
+            beat = start
+            while True:
+                try:
+                    i, host, buf = self._q.get(timeout=0.05)
+                    break
+                except queue.Empty:
+                    now = time.perf_counter()
+                    if now - beat >= t.heartbeat_s:
+                        t.heartbeat("prefetch-consumer",
+                                    state="queue_empty", expect=expect,
+                                    waiting_s=round(now - start, 3))
+                        beat = now
+            t.count("prefetch.consumer_wait_s",
+                    time.perf_counter() - start)
+            t.count("prefetch.chunks_consumed")
         if i is self._SENTINEL:
             raise host   # the producer's exception, delivered in-band
         if i != expect:
@@ -438,13 +502,19 @@ class ChunkedGLMObjective:
         transfers ahead regardless), so the fence costs a dispatch
         bubble, not overlap."""
         self.sweeps += 1
+        telemetry.count("solver.sweeps")
         bounded = self.batch.store is not None
         acc = None
-        for cur in self._chunk_stream():
-            if bounded and acc is not None:
-                jax.block_until_ready(acc)
-            out = per_chunk(cur)
-            acc = out if acc is None else combine(acc, out)
+        with telemetry.span("sweep", cat="solver",
+                            chunks=self.batch.n_chunks):
+            for cur in self._chunk_stream():
+                # The span covers the backpressure fence too: that wait
+                # IS the previous chunk's device compute retiring.
+                with telemetry.span("chunk_compute", cat="device"):
+                    if bounded and acc is not None:
+                        jax.block_until_ready(acc)
+                    out = per_chunk(cur)
+                acc = out if acc is None else combine(acc, out)
         return acc
 
     # -- TwiceDiffFunction surface (batch owned) ---------------------------
@@ -553,29 +623,35 @@ class ChunkedGLMObjective:
         a full data pass like any other)."""
         pending = []
         bounded = self.batch.store is not None
-        for i, cur in enumerate(self._chunk_stream()):
-            if bounded and pending:
-                # Backpressure (see _sweep): chunk i-1's compute must
-                # retire before chunk i dispatches, or every placed
-                # chunk stays live in the dispatch queue.  Only the
-                # [rows]-sized margins are fenced — their async D2H
-                # copies keep overlapping later chunks' compute.
-                jax.block_until_ready(pending[-1][0])
-            m = fn(cur)
-            try:
-                m.copy_to_host_async()
-            except AttributeError:
-                pass
-            lo, hi = self.batch.chunk_slice(i)
-            pending.append((m, hi - lo))
-        if not pending:
-            return np.zeros(0, np.float32)
-        # device_get, not np.asarray: the harvest is a PLANNED
-        # device-to-host copy, and the explicit spelling keeps it
-        # allowed under guards.no_implicit_transfers (the async copies
-        # above already landed most bytes; this just materializes).
-        return np.concatenate(
-            [jax.device_get(m)[:rows] for m, rows in pending])
+        telemetry.count("solver.per_example_passes")
+        with telemetry.span("per_example_pass", cat="solver",
+                            chunks=self.batch.n_chunks):
+            for i, cur in enumerate(self._chunk_stream()):
+                with telemetry.span("chunk_compute", cat="device"):
+                    if bounded and pending:
+                        # Backpressure (see _sweep): chunk i-1's compute
+                        # must retire before chunk i dispatches, or
+                        # every placed chunk stays live in the dispatch
+                        # queue.  Only the [rows]-sized margins are
+                        # fenced — their async D2H copies keep
+                        # overlapping later chunks' compute.
+                        jax.block_until_ready(pending[-1][0])
+                    m = fn(cur)
+                try:
+                    m.copy_to_host_async()
+                except AttributeError:
+                    pass
+                lo, hi = self.batch.chunk_slice(i)
+                pending.append((m, hi - lo))
+            if not pending:
+                return np.zeros(0, np.float32)
+            # device_get, not np.asarray: the harvest is a PLANNED
+            # device-to-host copy, and the explicit spelling keeps it
+            # allowed under guards.no_implicit_transfers (the async
+            # copies above already landed most bytes; this just
+            # materializes).
+            return np.concatenate(
+                [jax.device_get(m)[:rows] for m, rows in pending])
 
     def predict_margins(self, w: Array) -> np.ndarray:
         """Per-example margins (offsets included) over all chunks."""
@@ -689,6 +765,7 @@ def streaming_lbfgs_solve(
             w_try = w + alpha * d
             if owlqn:
                 w_try = jnp.where(jnp.sign(w_try) == xi, w_try, 0.0)
+            telemetry.count("solver.ls_trials")
             if step == 0 or full_value is None:
                 f_try, g_try = full_value_grad(w_try)
             else:
@@ -726,6 +803,7 @@ def streaming_lbfgs_solve(
             loss_converged(f_new, f, config.rel_tolerance))
         stalled = not ls_ok   # no measurable decrease possible
         it += 1
+        telemetry.count("solver.iterations")
         if config.track_states:
             tracker = tracker.record(jnp.asarray(it, jnp.int32),
                                      f_new, g_norm)
@@ -841,6 +919,7 @@ def streaming_lbfgs_solve_swept(
         # iteration for the whole grid); later trials are value-only.
         alpha = jnp.ones((L,), W.dtype)
         W_try = project(W + alpha[:, None] * D)
+        telemetry.count("solver.ls_trials")
         F1, G1 = full_vg(W_try)
         ok = armijo(W_try, F1)
         accepted = ok | done
@@ -858,6 +937,7 @@ def streaming_lbfgs_solve_swept(
             # Accepted lanes re-evaluate at their committed point (the
             # sweep is shared; their rows are simply ignored).
             W_eval = jnp.where(accepted[:, None], W_acc, W_try)
+            telemetry.count("solver.ls_trials")
             F_eval = full_val(W_eval)
             ok = armijo(W_eval, F_eval) & jnp.logical_not(accepted)
             W_acc = jnp.where(ok[:, None], W_try, W_acc)
@@ -882,6 +962,7 @@ def streaming_lbfgs_solve_swept(
         if bool(jnp.any(need_grad)):
             # One shared sweep recovers every lane's gradient at its
             # committed point.
+            telemetry.count("solver.grad_recovery_sweeps")
             F_new, G_new = full_vg(W_new)
         else:
             G_new = G_acc
@@ -904,6 +985,7 @@ def streaming_lbfgs_solve_swept(
         )
         stalled = jnp.logical_not(ls_ok) & active
         it += 1
+        telemetry.count("solver.iterations")
         iters = jnp.where(active, it, iters)
         if config.track_states:
             t_vals = t_vals.at[:, it].set(

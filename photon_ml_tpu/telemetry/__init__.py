@@ -1,0 +1,552 @@
+"""Pipeline telemetry: spans, metrics, and trace export (ISSUE 7).
+
+The out-of-core tier (rounds 8-10) is a multi-threaded pipeline — disk
+reader, host stager, async device dispatch, sink writer — whose
+performance story was previously reconstructed by hand from bench
+deltas.  Attributing time to STAGES, not end-to-end timing, is what
+finds the next lever (PAPERS.md: the Spark-ML stage-attribution study;
+Snap ML's pipelined hierarchy is only tunable if stall/overlap at each
+level is measurable).  This package makes the pipeline observable:
+
+- **Span tracer**: nested, thread-aware spans (``telemetry.span``)
+  recorded per-thread and merged at close.  One streamed fit yields a
+  timeline of prefetcher disk reads, host staging, device compute, and
+  sink writes across threads.
+- **Metrics registry**: counters / gauges / histograms (bounded
+  reservoirs) — LRU hits vs disk loads, prefetch stall vs consumer
+  wait seconds, sweeps odometer, line-search trials, sink queue depth,
+  XLA compile events (bridged from ``analysis.guards``' listener), and
+  a background RSS sampler.
+- **Export**: everything writes through the existing
+  ``utils.run_log.RunLogger`` JSONL (``telemetry_summary`` + per-span
+  ``span`` events in trace mode) and — in ``trace`` mode — a Chrome
+  trace-event ``trace.json`` loadable in Perfetto / ``chrome://tracing``.
+- **Report**: ``python -m photon_ml_tpu.telemetry report
+  <run_log.jsonl>`` prints per-phase wall-clock tables, prefetcher
+  overlap efficiency (fraction of streamed pass time the consumer was
+  blocked on the queue), and a reconciliation check that stage spans
+  account for the measured wall clock.
+
+Modes (``TrainingConfig.telemetry`` / ``ScoringConfig.telemetry``):
+
+- ``off`` (default): the module-level helpers are no-ops against a
+  null singleton — zero events, zero extra compiles, no measurable
+  overhead on the per-chunk hot paths (a global read + early return).
+- ``metrics``: counters/gauges/histograms active; finished spans fold
+  into bounded per-name duration stats (no per-span retention).
+- ``trace``: ``metrics`` plus full span retention and ``trace.json``.
+
+Thread-safety contract (photon-lint ``unlocked-shared-write``): all
+shared registries mutate under one lock; per-span hot state lives on a
+``threading.local``; heartbeat / exception events go straight through
+the (internally locked) ``RunLogger``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+MODES = ("off", "metrics", "trace")
+
+# Span names that represent one full streamed data pass — the basis for
+# the prefetcher overlap-efficiency derivation (consumer blocked time /
+# total streamed pass time).
+PASS_SPANS = ("sweep", "per_example_pass", "score_pass", "re_sweep")
+
+# Bounded-reservoir cap for histograms and sampled gauges: when full,
+# the reservoir decimates to every-other sample and doubles its stride
+# (deterministic — no RNG in the telemetry path).
+_RESERVOIR_CAP = 1024
+
+
+class _NullSpan:
+    """The off-path span: a shared, stateless context manager."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+# The active session (None = telemetry off).  Module-global by design:
+# instrumentation sites are deep library code (prefetch threads, chunk
+# stores) that cannot thread a handle through every call.
+_ACTIVE: "Telemetry | None" = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def active() -> "Telemetry | None":
+    """The active session, or None when telemetry is off."""
+    return _ACTIVE
+
+
+def span(name: str, cat: str = "app", **args):
+    """Context manager timing a nested, thread-aware span.  A no-op
+    singleton when telemetry is off (the hot-path contract)."""
+    t = _ACTIVE
+    if t is None:
+        return _NULL_SPAN
+    return _Span(t, name, cat, args or None)
+
+
+def count(name: str, n=1) -> None:
+    """Increment counter ``name`` (int or float increments)."""
+    t = _ACTIVE
+    if t is not None:
+        t.count(name, n)
+
+
+def gauge(name: str, value) -> None:
+    """Set gauge ``name`` (last/min/max retained; sampled in trace)."""
+    t = _ACTIVE
+    if t is not None:
+        t.gauge(name, value)
+
+
+def observe(name: str, value) -> None:
+    """Fold ``value`` into histogram ``name`` (count/sum/min/max +
+    bounded reservoir)."""
+    t = _ACTIVE
+    if t is not None:
+        t.observe(name, value)
+
+
+def heartbeat(stage: str, **fields) -> None:
+    """Immediate liveness event from a pipeline thread (hung-run
+    diagnosability: a stalled fit shows which stage stopped)."""
+    t = _ACTIVE
+    if t is not None:
+        t.heartbeat(stage, **fields)
+
+
+def thread_exception(stage: str, error: BaseException, **fields) -> None:
+    """Immediate death event from a pipeline thread (written before
+    the error rides the queue to the consumer)."""
+    t = _ACTIVE
+    if t is not None:
+        t.thread_exception(stage, error, **fields)
+
+
+class _Span:
+    """One live span; produced by ``span()`` when a session is active.
+
+    Start/duration use ``time.perf_counter`` (monotonic — the
+    naked-clock rule); the recorded ``ts`` is on the session's
+    RunLogger clock so span timestamps line up with the JSONL ``t``
+    field."""
+
+    __slots__ = ("_t", "name", "cat", "args", "ts", "t0", "depth")
+
+    def __init__(self, t: "Telemetry", name: str, cat: str, args):
+        self._t = t
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        tl = self._t._local
+        stack = getattr(tl, "stack", None)
+        if stack is None:
+            stack = tl.stack = []
+            self._t._register_thread()
+        self.depth = len(stack)
+        stack.append(self)
+        self.ts = self._t.now()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self.t0
+        self._t._local.stack.pop()
+        self._t._finish_span(self, dur, failed=exc_type is not None)
+        return False
+
+
+class _RssSampler:
+    """Background RSS sampler: ``/proc/self/status`` VmRSS at a fixed
+    period into the ``proc.rss_mb`` gauge (+ a (ts, mb) series for the
+    trace counter track).  Worker/caller shared state lives under one
+    lock (photon-lint thread contract); ``Event`` stops the thread."""
+
+    def __init__(self, t: "Telemetry", period_s: float):
+        self._t = t
+        self._period = period_s
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._samples: list = []
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="photon-telemetry-rss")
+
+    @staticmethod
+    def _rss_mb() -> float | None:
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return int(line.split()[1]) / 1024.0
+        except OSError:
+            return None
+        return None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            mb = self._rss_mb()
+            if mb is not None:
+                self._t.gauge("proc.rss_mb", mb)
+                with self._lock:
+                    self._samples.append((self._t.now(), mb))
+                    if len(self._samples) > _RESERVOIR_CAP:
+                        del self._samples[::2]
+            self._stop.wait(self._period)
+
+    def start(self) -> None:
+        if self._rss_mb() is not None:   # /proc present
+            self._thread.start()
+
+    def close(self) -> list:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join()
+        with self._lock:
+            return list(self._samples)
+
+
+class _CompileBridge(logging.Handler):
+    """Bridges XLA compile events into the metrics registry.
+
+    Listens exactly like ``analysis.guards.count_compiles`` (same
+    record pattern from the jax logger under ``jax.log_compiles``):
+    each compiled program bumps the ``jax.compiles`` counter and — in
+    trace mode — lands as an instant event on the compiling thread's
+    track, so a mid-sweep retrace is visible in the timeline."""
+
+    def __init__(self, t: "Telemetry"):
+        super().__init__(level=logging.DEBUG)
+        self._t = t
+
+    def emit(self, record: logging.LogRecord) -> None:
+        from photon_ml_tpu.analysis.guards import _COMPILE_RE
+
+        try:
+            m = _COMPILE_RE.match(record.getMessage())
+        except Exception:       # a guard must never break the run
+            return
+        if m:
+            self._t.count("jax.compiles")
+            self._t._instant("xla_compile", "jax", {"program": m.group(1)})
+
+
+class Telemetry:
+    """One telemetry session: tracer + metrics registry + exporters.
+
+    Create through ``start()`` / ``maybe_session()`` — the module-level
+    helpers dispatch to the single active session.  ``close()`` merges
+    per-thread spans, writes the ``telemetry_summary`` (+ per-span
+    events and ``trace.json`` in trace mode), and deactivates.
+    """
+
+    def __init__(self, mode: str, run_logger, telemetry_dir: str | None,
+                 heartbeat_s: float = 5.0, rss_period_s: float = 0.25,
+                 owns_logger: bool = False):
+        if mode not in ("metrics", "trace"):
+            raise ValueError(f"telemetry mode {mode!r} not in "
+                             "('metrics', 'trace')")
+        self.mode = mode
+        self.dir = telemetry_dir
+        self.heartbeat_s = float(heartbeat_s)
+        self._rss_period_s = float(rss_period_s)
+        self._log = run_logger
+        self._owns_logger = owns_logger
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._hists: dict = {}
+        self._span_stats: dict = {}
+        self._thread_spans: dict = {}     # tid -> [span records]
+        self._thread_names: dict = {}     # tid -> thread name
+        self._instants: list = []         # (ts, tid, name, cat, args)
+        self._sampler: _RssSampler | None = None
+        self._bridge: _CompileBridge | None = None
+        self._jax_stack: contextlib.ExitStack | None = None
+        self._closed = False
+
+    # -- clock --------------------------------------------------------------
+
+    def now(self) -> float:
+        """Seconds on the session RunLogger's monotonic clock (span
+        timestamps line up with JSONL event ``t`` fields)."""
+        return self._log.now()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _open(self) -> None:
+        self._log.event("telemetry_start", mode=self.mode,
+                        **({"dir": self.dir} if self.dir else {}))
+        self._sampler = _RssSampler(self, self._rss_period_s)
+        self._sampler.start()
+        # Compile bridge: best-effort (jax may be absent in a host-only
+        # driver); uses the guards listener's record pattern.
+        try:
+            import jax
+
+            self._jax_stack = contextlib.ExitStack()
+            self._jax_stack.enter_context(jax.log_compiles())
+            self._bridge = _CompileBridge(self)
+            jax_logger = logging.getLogger("jax")
+            self._bridge_old_level = jax_logger.level
+            jax_logger.addHandler(self._bridge)
+            # Records are emitted at WARNING; an app that raised the
+            # effective level above it would silently mute the bridge.
+            if jax_logger.getEffectiveLevel() > logging.WARNING:
+                jax_logger.setLevel(logging.WARNING)
+        except Exception as e:   # pragma: no cover - jax-less hosts
+            logger.info("telemetry: compile bridge unavailable (%r)", e)
+            self._bridge = None
+            self._jax_stack = None
+
+    def close(self) -> None:
+        """Merge, export, deactivate.  Idempotent."""
+        global _ACTIVE
+        if self._closed:
+            return
+        self._closed = True
+        rss_series = self._sampler.close() if self._sampler else []
+        if self._bridge is not None:
+            jax_logger = logging.getLogger("jax")
+            jax_logger.removeHandler(self._bridge)
+            jax_logger.setLevel(self._bridge_old_level)
+            self._bridge = None
+        if self._jax_stack is not None:
+            self._jax_stack.close()
+            self._jax_stack = None
+
+        summary = self.summary()
+        self._log.event("telemetry_summary", **summary)
+        if self.mode == "trace":
+            with self._lock:
+                merged = [dict(rec, tid=tid,
+                               thread=self._thread_names.get(tid, str(tid)))
+                          for tid, recs in self._thread_spans.items()
+                          for rec in recs]
+            merged.sort(key=lambda r: r["ts"])
+            for rec in merged:
+                self._log.event("span", **rec)
+            if self.dir is not None:
+                from photon_ml_tpu.telemetry.export import write_trace
+
+                os.makedirs(self.dir, exist_ok=True)
+                path = os.path.join(self.dir, "trace.json")
+                with self._lock:
+                    names = dict(self._thread_names)
+                    instants = list(self._instants)
+                write_trace(path, merged, names, instants, rss_series)
+                self._log.event("trace_written", path=path,
+                                spans=len(merged))
+        if self._owns_logger:
+            self._log.close()
+        with _ACTIVE_LOCK:
+            if _ACTIVE is self:
+                _ACTIVE = None
+
+    # -- metrics ------------------------------------------------------------
+
+    def count(self, name: str, n=1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge(self, name: str, value) -> None:
+        value = float(value)
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                self._gauges[name] = {"last": value, "min": value,
+                                      "max": value}
+            else:
+                g["last"] = value
+                g["min"] = min(g["min"], value)
+                g["max"] = max(g["max"], value)
+
+    def observe(self, name: str, value) -> None:
+        value = float(value)
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = {
+                    "count": 0, "sum": 0.0, "min": value, "max": value,
+                    "reservoir": [], "stride": 1}
+            h["count"] += 1
+            h["sum"] += value
+            h["min"] = min(h["min"], value)
+            h["max"] = max(h["max"], value)
+            if (h["count"] - 1) % h["stride"] == 0:
+                h["reservoir"].append(value)
+                if len(h["reservoir"]) >= _RESERVOIR_CAP:
+                    del h["reservoir"][::2]
+                    h["stride"] *= 2
+
+    # -- spans --------------------------------------------------------------
+
+    def span(self, name: str, cat: str = "app", **args) -> _Span:
+        return _Span(self, name, cat, args or None)
+
+    def _register_thread(self) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            self._thread_spans.setdefault(tid, [])
+            self._thread_names[tid] = threading.current_thread().name
+
+    def _finish_span(self, sp: _Span, dur: float, failed: bool) -> None:
+        key = sp.name
+        with self._lock:
+            st = self._span_stats.get(key)
+            if st is None:
+                st = self._span_stats[key] = {
+                    "cat": sp.cat, "count": 0, "total_s": 0.0,
+                    "min_s": dur, "max_s": dur}
+            st["count"] += 1
+            st["total_s"] += dur
+            st["min_s"] = min(st["min_s"], dur)
+            st["max_s"] = max(st["max_s"], dur)
+            if self.mode == "trace":
+                rec = {"name": sp.name, "cat": sp.cat,
+                       "ts": round(sp.ts, 6), "dur": round(dur, 6),
+                       "depth": sp.depth}
+                if sp.args:
+                    rec["args"] = sp.args
+                if failed:
+                    rec["failed"] = True
+                self._thread_spans[threading.get_ident()].append(rec)
+
+    def _instant(self, name: str, cat: str, args=None) -> None:
+        if self.mode != "trace":
+            return
+        with self._lock:
+            self._instants.append(
+                (self.now(), threading.get_ident(), name, cat, args))
+            if len(self._instants) > 4 * _RESERVOIR_CAP:
+                del self._instants[::2]
+
+    # -- liveness events ----------------------------------------------------
+
+    def heartbeat(self, stage: str, **fields) -> None:
+        self._log.event("heartbeat", stage=stage,
+                        thread=threading.current_thread().name, **fields)
+
+    def thread_exception(self, stage: str, error: BaseException,
+                         **fields) -> None:
+        self._log.event("thread_exception", stage=stage,
+                        thread=threading.current_thread().name,
+                        error=repr(error), **fields)
+
+    # -- summary ------------------------------------------------------------
+
+    @staticmethod
+    def _derived(counters: dict, spans: dict) -> dict:
+        """Cross-metric derivations from SNAPSHOT dicts (never the live
+        registries — summary() is called on live sessions, and pipeline
+        threads keep inserting span-stat keys): prefetcher overlap
+        efficiency = 1 − (consumer blocked on the queue / total
+        streamed pass time).  ~1.0 means the prefetch pipeline fully
+        hid the disk+staging tier under device compute."""
+        out: dict = {}
+        blocked = counters.get("prefetch.consumer_wait_s")
+        basis = sum(st["total_s"] for name, st in spans.items()
+                    if name in PASS_SPANS)
+        if blocked is not None and basis > 0:
+            frac = min(1.0, float(blocked) / basis)
+            out["consumer_blocked_fraction"] = round(frac, 4)
+            out["overlap_efficiency"] = round(1.0 - frac, 4)
+            out["pass_span_total_s"] = round(basis, 3)
+        stall = counters.get("prefetch.producer_stall_s")
+        if stall is not None and basis > 0:
+            out["producer_stall_fraction"] = round(
+                min(1.0, float(stall) / basis), 4)
+        return out
+
+    def summary(self) -> dict:
+        """JSON-ready snapshot of every registry (the
+        ``telemetry_summary`` event body; bench arms embed it)."""
+        with self._lock:
+            counters = {k: (round(v, 6) if isinstance(v, float) else v)
+                        for k, v in sorted(self._counters.items())}
+            gauges = {k: {f: round(x, 3) for f, x in v.items()}
+                      for k, v in sorted(self._gauges.items())}
+            hists = {}
+            for k, h in sorted(self._hists.items()):
+                hists[k] = {"count": h["count"],
+                            "sum": round(h["sum"], 6),
+                            "min": round(h["min"], 6),
+                            "max": round(h["max"], 6),
+                            "mean": round(h["sum"] / max(h["count"], 1), 6)}
+            spans = {k: {"cat": st["cat"], "count": st["count"],
+                         "total_s": round(st["total_s"], 6),
+                         "min_s": round(st["min_s"], 6),
+                         "max_s": round(st["max_s"], 6)}
+                     for k, st in sorted(self._span_stats.items())}
+        return {"mode": self.mode, "counters": counters, "gauges": gauges,
+                "histograms": hists, "spans": spans,
+                "derived": self._derived(counters, spans)}
+
+
+def start(mode: str, telemetry_dir: str | None = None, run_logger=None,
+          heartbeat_s: float = 5.0,
+          rss_period_s: float = 0.25) -> Telemetry:
+    """Activate a telemetry session (the one per process).
+
+    ``run_logger``: the events channel; when None a ``RunLogger`` is
+    created at ``<telemetry_dir>/run_log.jsonl`` (or a pure
+    stdlib-logging sink when ``telemetry_dir`` is also None) and owned
+    (closed) by the session."""
+    global _ACTIVE
+    if mode not in MODES:
+        raise ValueError(f"telemetry mode {mode!r} not in {MODES}")
+    if mode == "off":
+        raise ValueError("start() needs an active mode; gate 'off' at "
+                         "the caller (see maybe_session)")
+    owns = False
+    if run_logger is None:
+        from photon_ml_tpu.utils.run_log import RunLogger
+
+        path = (os.path.join(telemetry_dir, "run_log.jsonl")
+                if telemetry_dir else None)
+        run_logger = RunLogger(path)
+        owns = True
+    t = Telemetry(mode, run_logger, telemetry_dir,
+                  heartbeat_s=heartbeat_s, rss_period_s=rss_period_s,
+                  owns_logger=owns)
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None:
+            if owns:
+                run_logger.close()
+            raise RuntimeError("a telemetry session is already active")
+        _ACTIVE = t
+    t._open()
+    return t
+
+
+@contextlib.contextmanager
+def maybe_session(mode: str | None, telemetry_dir: str | None = None,
+                  run_logger=None, **kw):
+    """Session context honoring the config knob: ``off``/None (or an
+    already-active session — the driver configured one) yields without
+    creating anything; otherwise a session spans the block."""
+    if mode in (None, "off") or _ACTIVE is not None:
+        yield _ACTIVE
+        return
+    t = start(mode, telemetry_dir, run_logger, **kw)
+    try:
+        yield t
+    finally:
+        t.close()

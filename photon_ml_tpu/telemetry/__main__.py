@@ -1,0 +1,34 @@
+"""Telemetry CLI: ``python -m photon_ml_tpu.telemetry report <log>``.
+
+Prints the per-phase / stage-span / overlap / reconciliation report
+for a run's ``run_log.jsonl`` (see ``telemetry.report``); the last
+stdout line is one machine-parseable JSON object and the exit code is
+1 when the span-vs-wall-clock reconciliation check fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from photon_ml_tpu.telemetry.report import report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m photon_ml_tpu.telemetry",
+        description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser(
+        "report", help="per-phase wall-clock tables, prefetcher overlap "
+                       "efficiency, and the span reconciliation check")
+    rp.add_argument("log", help="path to a run_log.jsonl")
+    rp.add_argument("--threshold", type=float, default=0.9,
+                    help="reconciliation pass threshold (default 0.9)")
+    args = p.parse_args(argv)
+    result = report(args.log, threshold=args.threshold)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

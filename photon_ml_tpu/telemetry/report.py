@@ -1,0 +1,209 @@
+"""Telemetry report: per-phase tables, overlap efficiency, and the
+span-vs-wall-clock reconciliation check over a ``run_log.jsonl``.
+
+``python -m photon_ml_tpu.telemetry report <run_log.jsonl>`` prints:
+
+- **Phases**: the RunLogger ``phase_start``/``phase_end`` wall-clock
+  table (driver ETL / fit / save phases).
+- **Stage spans**: per-name duration stats from the
+  ``telemetry_summary`` event (count, total, mean, share of the
+  busiest thread's wall clock).
+- **Prefetcher**: overlap efficiency — the fraction of streamed pass
+  time the consumer was NOT blocked on the prefetch queue (1.0 = the
+  disk+staging tier fully hidden under device compute) — plus producer
+  stall and LRU hit/load counters.
+- **Liveness**: heartbeat counts per stage and any thread_exception
+  events (the hung-run forensic trail).
+- **Reconciliation**: for each thread with trace spans, the fraction
+  of wall clock (first depth-0 span start → last depth-0 span end)
+  covered by depth-0 spans.  The check passes when the busiest thread
+  covers at least ``--threshold`` (default 0.9) — i.e. the stage spans
+  actually account for where the time went.
+
+The last stdout line is one machine-parseable JSON object (the repo's
+CLI contract); exit code is 1 when the reconciliation check fails.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def load_events(path: str) -> list[dict]:
+    """Parse a run log, tolerating a torn tail: a killed run (the
+    report's primary forensic case) can leave a partial final line —
+    malformed lines are skipped, not fatal."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                out.append({"event": "_malformed_line"})
+    return out
+
+
+def _phases(events: list[dict]) -> list[tuple[str, float]]:
+    out = []
+    for ev in events:
+        if ev.get("event") == "phase_end":
+            out.append((ev.get("phase", "?"),
+                        float(ev.get("duration_s", 0.0))))
+    return out
+
+
+def reconcile(events: list[dict]) -> dict:
+    """Per-thread depth-0 span coverage of that thread's wall clock.
+
+    Depth-0 spans on one thread cannot overlap (they come off a stack),
+    so covered time is a plain sum; wall clock is last end − first
+    start.  Returns ``{threads: {name: {...}}, coverage, thread}``
+    where ``coverage`` is the busiest (most covered seconds) thread's
+    fraction — the reconciliation number of record."""
+    per_tid: dict = {}
+    for ev in events:
+        if ev.get("event") != "span" or ev.get("depth", 0) != 0:
+            continue
+        tid = ev.get("tid", 0)
+        ts, dur = float(ev["ts"]), float(ev["dur"])
+        ent = per_tid.setdefault(
+            tid, {"thread": ev.get("thread", str(tid)), "covered_s": 0.0,
+                  "start": ts, "end": ts + dur, "spans": 0})
+        ent["covered_s"] += dur
+        ent["start"] = min(ent["start"], ts)
+        ent["end"] = max(ent["end"], ts + dur)
+        ent["spans"] += 1
+    threads = {}
+    best = None
+    for tid, ent in per_tid.items():
+        wall = max(ent["end"] - ent["start"], 1e-9)
+        cov = min(1.0, ent["covered_s"] / wall)
+        threads[ent["thread"]] = {
+            "spans": ent["spans"],
+            "covered_s": round(ent["covered_s"], 3),
+            "wall_s": round(wall, 3),
+            "coverage": round(cov, 4),
+        }
+        if best is None or ent["covered_s"] > best[1]:
+            best = (ent["thread"], ent["covered_s"], cov)
+    return {
+        "threads": threads,
+        "thread": best[0] if best else None,
+        "coverage": round(best[2], 4) if best else None,
+    }
+
+
+def report(path: str, threshold: float = 0.9, out=None) -> dict:
+    """Print the report for ``path``; returns the JSON summary dict."""
+    out = out or sys.stdout
+    events = load_events(path)
+    summary = None
+    for ev in events:
+        if ev.get("event") == "telemetry_summary":
+            summary = ev         # last one wins (append-mode logs)
+
+    w = lambda s="": print(s, file=out)
+    phases = _phases(events)
+    if phases:
+        w("Phases (run log):")
+        w(f"  {'phase':<28} {'wall_s':>10}")
+        for name, dur in phases:
+            w(f"  {name:<28} {dur:>10.3f}")
+        w()
+
+    spans = (summary or {}).get("spans", {})
+    if spans:
+        total_all = sum(st["total_s"] for st in spans.values())
+        w("Stage spans:")
+        w(f"  {'name':<24} {'cat':<8} {'count':>7} {'total_s':>10} "
+          f"{'mean_ms':>9} {'share':>7}")
+        for name, st in sorted(spans.items(),
+                               key=lambda kv: -kv[1]["total_s"]):
+            mean_ms = 1e3 * st["total_s"] / max(st["count"], 1)
+            share = st["total_s"] / total_all if total_all else 0.0
+            w(f"  {name:<24} {st['cat']:<8} {st['count']:>7} "
+              f"{st['total_s']:>10.3f} {mean_ms:>9.2f} {share:>6.1%}")
+        w()
+
+    derived = (summary or {}).get("derived", {})
+    counters = (summary or {}).get("counters", {})
+    overlap = derived.get("overlap_efficiency")
+    if overlap is not None:
+        w("Prefetcher:")
+        w(f"  consumer blocked {counters.get('prefetch.consumer_wait_s', 0.0):.3f} s"
+          f" of {derived.get('pass_span_total_s', 0.0):.3f} s streamed pass time"
+          f" ({derived.get('consumer_blocked_fraction', 0.0):.1%})"
+          f" -> overlap efficiency {overlap:.1%}")
+        if "producer_stall_fraction" in derived:
+            w(f"  producer stalled on a full queue "
+            f"{counters.get('prefetch.producer_stall_s', 0.0):.3f} s "
+              f"({derived['producer_stall_fraction']:.1%} of pass time)")
+        hits = counters.get("store.hits")
+        loads = counters.get("store.loads")
+        if hits is not None or loads is not None:
+            w(f"  chunk source: {hits or 0} LRU window hits, "
+              f"{loads or 0} disk loads, "
+              f"{counters.get('store.rebuilds', 0)} rebuilds")
+        w()
+
+    torn = sum(1 for ev in events if ev.get("event") == "_malformed_line")
+    if torn:
+        w(f"NOTE: {torn} malformed line(s) skipped (torn tail — the "
+          "run likely died mid-write).")
+        w()
+
+    beats: dict = {}
+    deaths = []
+    for ev in events:
+        if ev.get("event") == "heartbeat":
+            beats[ev.get("stage", "?")] = beats.get(
+                ev.get("stage", "?"), 0) + 1
+        elif ev.get("event") == "thread_exception":
+            deaths.append(ev)
+    if beats or deaths:
+        w("Liveness:")
+        for stage, n in sorted(beats.items()):
+            w(f"  {stage}: {n} heartbeats")
+        for ev in deaths:
+            w(f"  DIED {ev.get('stage')}: {ev.get('error')} "
+              f"(thread {ev.get('thread')}, t={ev.get('t')})")
+        w()
+
+    recon = reconcile(events)
+    ok = True
+    if recon["coverage"] is not None:
+        w("Reconciliation (depth-0 spans vs wall clock, per thread):")
+        for name, ent in sorted(recon["threads"].items()):
+            w(f"  {name}: {ent['covered_s']:.3f} s covered of "
+              f"{ent['wall_s']:.3f} s wall ({ent['coverage']:.1%}, "
+              f"{ent['spans']} spans)")
+        ok = recon["coverage"] >= threshold
+        w(f"  busiest thread '{recon['thread']}' coverage "
+          f"{recon['coverage']:.1%} "
+          f"{'>=' if ok else '<'} threshold {threshold:.0%} "
+          f"-> {'PASS' if ok else 'FAIL'}")
+        w()
+    elif summary is None:
+        w("No telemetry_summary event found (telemetry was off, or the "
+          "run died before close).")
+        w()
+
+    result = {
+        "ok": ok,
+        "phases": {name: dur for name, dur in phases},
+        "overlap_efficiency": overlap,
+        "consumer_blocked_fraction": derived.get(
+            "consumer_blocked_fraction"),
+        "reconciliation": recon["coverage"],
+        "reconciliation_thread": recon["thread"],
+        "reconciliation_threads": recon["threads"],
+        "counters": counters,
+        "heartbeats": beats,
+        "thread_exceptions": len(deaths),
+        "mode": (summary or {}).get("mode"),
+    }
+    print(json.dumps(result), file=out)
+    return result

@@ -9,14 +9,24 @@ The rebuild upgrades free-text logs to structured JSONL — one event per
 line with a monotonic timestamp — so convergence traces and phase
 timings are machine-readable (the reference's observability gap).  The
 same events also go to the stdlib logger for human eyes.
+
+Since ISSUE 7 the logger is the telemetry tier's event channel too:
+``event`` is thread-safe (heartbeats arrive from prefetch/sink
+threads), ``timed`` phases double as telemetry spans when a session is
+active, and the file handle has a real lifecycle — ``close()``,
+context-manager support, and an ``atexit`` flush fallback so an
+abandoned logger can no longer leak its handle (or its last buffered
+events) on interpreter exit.
 """
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import json
 import logging
 import os
+import threading
 import time
 
 logger = logging.getLogger("photon_ml_tpu")
@@ -37,16 +47,30 @@ class RunLogger:
         self.path = path
         self._t0 = time.monotonic()
         self._f = None
+        # Events arrive from pipeline threads too (telemetry heartbeats,
+        # span merges): one lock keeps lines whole and the handle state
+        # coherent (photon-lint unlocked-shared-write contract).
+        self._lock = threading.Lock()
         if path is not None:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._f = open(path, mode)
+            # Flush fallback: a logger abandoned without close() (the
+            # pre-ISSUE-7 driver bug) still lands its buffered tail on
+            # interpreter exit.  Unregistered again in close().
+            atexit.register(self.close)
+
+    def now(self) -> float:
+        """Seconds on this logger's monotonic clock (the ``t`` field);
+        telemetry spans stamp themselves on the same clock."""
+        return time.monotonic() - self._t0
 
     def event(self, kind: str, **fields) -> None:
-        rec = {"t": round(time.monotonic() - self._t0, 6), "event": kind}
+        rec = {"t": round(self.now(), 6), "event": kind}
         rec.update(fields)
-        if self._f is not None:
-            self._f.write(json.dumps(rec) + "\n")
-            self._f.flush()
+        with self._lock:
+            if self._f is not None:
+                self._f.write(json.dumps(rec) + "\n")
+                self._f.flush()
         logger.info("%s %s", kind, fields)
 
     @contextlib.contextmanager
@@ -56,7 +80,14 @@ class RunLogger:
         ``profile_dir``: when set, the phase also runs under
         ``jax.profiler.trace`` — a TensorBoard/XProf device trace lands
         there (SURVEY §5.1: tracing is a first-class aux subsystem).
+
+        When a telemetry session is active the phase is also a span
+        (cat ``phase``), so driver phases appear on the trace timeline
+        and in the report's reconciliation alongside the streaming
+        tier's stage spans.
         """
+        from photon_ml_tpu import telemetry
+
         self.event("phase_start", phase=phase, **fields)
         start = time.monotonic()
         prof = contextlib.nullcontext()
@@ -65,7 +96,7 @@ class RunLogger:
 
             prof = jax.profiler.trace(profile_dir)
         try:
-            with prof:
+            with telemetry.span(phase, cat="phase"), prof:
                 yield
         finally:
             self.event(
@@ -76,9 +107,23 @@ class RunLogger:
             )
 
     def close(self) -> None:
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        """Flush and release the file handle.  Idempotent (also runs
+        as the atexit fallback)."""
+        with self._lock:
+            f, self._f = self._f, None
+        if f is not None:
+            f.close()
+            # An explicitly closed logger must not resurrect at exit
+            # (atexit holds a ref to the bound method otherwise).
+            with contextlib.suppress(Exception):
+                atexit.unregister(self.close)
+
+    def __enter__(self) -> "RunLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False
 
 
 def read_run_log(path: str) -> list[dict]:

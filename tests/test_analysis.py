@@ -440,6 +440,88 @@ def test_env_read_waiver():
 
 
 # ---------------------------------------------------------------------------
+# naked-clock
+# ---------------------------------------------------------------------------
+
+
+def test_naked_clock_flags_direct_subtraction():
+    vs = check_source(_src("""
+        import time
+
+        def f():
+            t0 = time.time()
+            work()
+            return time.time() - t0
+    """))
+    assert _rules(vs) == ["naked-clock"]
+    assert vs[0].line == 6
+
+
+def test_naked_clock_flags_assigned_name_and_self_attr():
+    vs = check_source(_src("""
+        import time
+
+        class Budget:
+            def __init__(self, budget_s):
+                self.deadline = time.time() + budget_s
+
+            def remaining(self):
+                return self.deadline - time.time()
+
+        def g():
+            start = time.time()
+            return later() - start
+    """))
+    assert _rules(vs) == ["naked-clock", "naked-clock"]
+
+
+def test_naked_clock_accepts_monotonic_and_timestamps():
+    vs = check_source(_src("""
+        import time
+
+        def f():
+            t0 = time.perf_counter()
+            started_at = time.time()     # epoch timestamp: legal
+            record(started_at)
+            dur = time.perf_counter() - t0
+            m = time.monotonic()
+            return dur, time.monotonic() - m
+    """))
+    assert vs == []
+
+
+def test_naked_clock_taint_is_function_scoped():
+    """A wall-clock assignment in one function must not flag another
+    function's monotonic math on the same conventional name (review
+    finding: a file-global taint set made `t0` radioactive
+    everywhere)."""
+    vs = check_source(_src("""
+        import time
+
+        def a():
+            t0 = time.time()        # epoch timestamp, never subtracted
+            record(t0)
+
+        def b():
+            t0 = time.perf_counter()
+            work()
+            return time.perf_counter() - t0
+    """))
+    assert vs == []
+
+
+def test_naked_clock_waiver():
+    vs = check_source(_src("""
+        import time
+
+        def f(remote_epoch):
+            # photon-lint: disable=naked-clock (cross-process epoch delta)
+            return time.time() - remote_epoch
+    """))
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
 # slow-unmarked (repo-level, recorded durations)
 # ---------------------------------------------------------------------------
 
@@ -559,6 +641,7 @@ def test_slow_unmarked_accepts_module_pytestmark(tmp_path):
 _CORPUS = """
     import os
     import threading
+    import time
 
     import jax
     import jax.numpy as jnp
@@ -567,6 +650,12 @@ _CORPUS = """
 
     def per_call(x):
         return jax.jit(lambda y: y)(x)
+
+
+    def wall_clock_duration():
+        t0 = time.time()
+        per_call(jnp.ones(3))
+        return time.time() - t0
 
 
     @jax.jit
@@ -611,8 +700,8 @@ def test_fixture_corpus_detects_five_distinct_rules():
     vs = check_source(_src(_CORPUS))
     distinct = set(_rules(vs))
     assert {"jit-in-function", "tracer-hygiene", "unlocked-shared-write",
-            "accumulator-dtype", "env-read"} <= distinct
-    assert len(distinct) >= 5
+            "accumulator-dtype", "env-read", "naked-clock"} <= distinct
+    assert len(distinct) >= 6
 
 
 def test_repo_clean():

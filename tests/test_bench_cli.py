@@ -110,6 +110,20 @@ def test_bench_stream_section_contract(tmp_path):
     for arm in ("spilled", "resident"):
         assert s[arm]["guards"]["sweep_compiles"] == 0, \
             s[arm]["guards"]
+    # ISSUE 7: each arm's record carries the telemetry summary block;
+    # the spilled arm streams through the prefetcher, so the overlap
+    # derivation is defined and the pinned counters are live.
+    for arm in ("spilled", "resident"):
+        assert "telemetry" in s[arm], sorted(s[arm])
+    tel = s["spilled"]["telemetry"]
+    assert tel["sweeps"] == s["sweeps_timed"]
+    assert tel["overlap_efficiency"] is not None
+    assert 0.0 <= tel["overlap_efficiency"] <= 1.0
+    assert tel["consumer_wait_s"] >= 0.0
+    assert tel["store_loads"] + tel["store_hits"] > 0
+    # Steady-state sweeps under telemetry still compile nothing (the
+    # guard budget and the bridge agree).
+    assert tel["compiles"] == 0, tel
     # Chunks must dwarf the window (the RSS-bound claim's precondition)
     assert s["n_chunks"] >= 6 * s["host_max_resident"]
     # LRU bound held during the spilled arm's sweeps.
@@ -191,6 +205,12 @@ def test_bench_re_section_contract(tmp_path):
     assert all(a <= b for a, b in zip(retired, retired[1:]))
     assert retired[-1] > 0
     assert r["retirement_work_fraction"] < 1.0
+    # ISSUE 7: the streamed arm's telemetry block reports the prefetch
+    # overlap story for the entity-chunk pipeline.
+    tel = r["streamed"]["telemetry"]
+    assert tel["sweeps"] == r["sweeps"] - 1      # sweep 0 untelemetered
+    assert tel["overlap_efficiency"] is not None
+    assert "telemetry" in r["resident"]
     # Retirement must not move the model beyond solver tolerance.
     assert r["coef_parity_max"] < 1e-2
     assert r["score_parity_max"] < 1e-2

@@ -200,6 +200,47 @@ def test_tracer_leak_guard_catches_leak():
             jax.jit(f)(jnp.ones(13))
 
 
+def test_telemetry_adds_no_compiles_on_or_off(rng, tmp_path):
+    """ISSUE-7 budget: the telemetry tier must be free at the compile
+    level.  A warm re-fit compiles ZERO new programs with telemetry
+    OFF (the default everywhere above) AND with a live metrics session
+    — the instrumentation is pure host bookkeeping, never a traced
+    value or a new program."""
+    from photon_ml_tpu import telemetry
+
+    _swept_fit(_chunked(rng, n_chunks=4))        # warm the shapes
+    with count_compiles() as off:
+        _swept_fit(_chunked(rng, n_chunks=4))
+    assert off.count == 0, off.programs
+
+    t = telemetry.start("metrics")
+    try:
+        with count_compiles() as on:
+            _swept_fit(_chunked(rng, n_chunks=4,
+                                spill_dir=str(tmp_path / "spill")))
+        summary = t.summary()
+    finally:
+        t.close()
+    assert on.count == 0, on.programs
+    # The session actually observed the fit (sweeps + prefetch).
+    assert summary["counters"]["solver.sweeps"] > 0
+    assert summary["counters"]["prefetch.chunks_consumed"] > 0
+    assert summary["derived"]["overlap_efficiency"] is not None
+
+
+def test_telemetry_off_keeps_prefetcher_blocking_path(rng):
+    """With telemetry off the prefetcher consumer takes the plain
+    blocking q.get() path — no polling wake-ups, no counters (the
+    <=1% pass-time overhead contract's mechanism)."""
+    from photon_ml_tpu import telemetry
+
+    assert telemetry.active() is None
+    cobj = _chunked(rng, n_chunks=4)
+    w = jnp.zeros(D, jnp.float32)
+    f, _ = cobj.value_and_gradient(w)
+    assert np.isfinite(float(f))
+
+
 def test_device_score_sparse_compiles_once(rng):
     """The ISSUE-6 true-positive fix pinned: _device_score_sparse used
     to construct ``jax.jit(gather_rowsum)`` per CALL (fresh executable
