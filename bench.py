@@ -68,7 +68,12 @@ from contextlib import ExitStack
 
 import numpy as np
 
-V5E_PEAK_GBPS = 819.0
+# Single source of truth for the platform peak (ISSUE 8): the telemetry
+# device-accounting table and this bench must emit the SAME roofline
+# basis or one record would carry two disagreeing estimates.
+from photon_ml_tpu.telemetry.device import PLATFORM_PEAK_GBPS
+
+V5E_PEAK_GBPS = PLATFORM_PEAK_GBPS["tpu"][0]
 
 DEFAULT_SECTIONS = ("etl", "cached", "grr", "segment_sum", "colmajor")
 ALL_SECTIONS = DEFAULT_SECTIONS + ("powerlaw", "chunked", "sweep",
@@ -766,6 +771,9 @@ def _telemetry_block(summary: dict, sweeps_key: str = "solver.sweeps") -> dict:
         "store_hits": c.get("store.hits", 0),
         "store_loads": c.get("store.loads", 0),
         "compiles": c.get("jax.compiles", 0),
+        # Captured XLA program costs (ISSUE 8): whatever the arm's
+        # instrumented paths resolved during the telemetry window.
+        "device_cost": summary.get("device", {}).get("programs") or None,
     }
 
 
@@ -839,6 +847,13 @@ def stream_arm_main(args) -> int:
     from photon_ml_tpu import telemetry
 
     tel = telemetry.start("metrics")
+    # Device cost (ISSUE 8) rides the IN-SWEEP capture on the first
+    # timed pass: it reuses the chunk that pass already loaded (an
+    # explicit pre-capture here would bump store.hits/loads with an
+    # access the timed sweeps never made), emits no "Compiling" record
+    # (lowering cache → the --guards zero-compile contract holds), and
+    # its one-time AOT relower lands in a single pass that the
+    # median-of-5 timing excludes.
     guard_stack = ExitStack()
     compile_log = None
     if args.guards:
@@ -890,6 +905,11 @@ def stream_arm_main(args) -> int:
                           if anon is not None
                           and base_anon_mb is not None else None),
         "telemetry": _telemetry_block(tel_summary),
+        # The per-chunk value+gradient program's XLA cost analysis +
+        # roofline estimate (ISSUE 8 acceptance: FLOPs, bytes, and the
+        # analytic time floor ride the arm's JSON).
+        "device_cost": tel_summary.get("device", {}).get(
+            "programs", {}).get("chunk_vg"),
     }
     if compile_log is not None:
         rec["guards"] = {
@@ -1502,6 +1522,22 @@ def _finalize(ctx: BenchContext, platform: str) -> dict:
         out["roofline_fraction"] = (
             round(achieved / V5E_PEAK_GBPS, 4)
             if platform == "tpu" else None)
+        # Emitted device-cost block for the GRR step (ISSUE 8): the
+        # Mosaic kernel is opaque to XLA cost_analysis (a custom call),
+        # so its bytes come from the PLAN — the analytic stream count
+        # _grr_stream_bytes already audits — and the roofline estimate
+        # is those bytes over the platform peak.  PERF.md's hand math,
+        # now a field in every bench record.
+        roofline_ms = grr_bytes / (V5E_PEAK_GBPS * 1e9) * 1e3
+        out["device_cost"] = {"grr_step": {
+            "bytes_accessed": int(grr_bytes),
+            "bytes_source": "analytic plan stream count",
+            "peak_gbps": V5E_PEAK_GBPS,
+            "roofline_est_ms": round(roofline_ms, 3),
+            "measured_step_ms": round(t_grr * 1e3, 3),
+            "roofline_fraction": (round(roofline_ms / (t_grr * 1e3), 4)
+                                  if platform == "tpu" else None),
+        }}
     else:
         out["achieved_hbm_gbps"] = None
         out["roofline_fraction"] = None
@@ -1529,6 +1565,11 @@ def main(argv: list[str] | None = None) -> int:
                         "path, so repeated driver runs hit warm")
     p.add_argument("--no-compile-cache", action="store_true",
                    help="do not enable the persistent XLA cache")
+    p.add_argument("--history-dir", default=None,
+                   help="append this run's JSON record (as a "
+                        "schema-versioned envelope file) into the "
+                        "directory; gate the trajectory with "
+                        "python -m photon_ml_tpu.telemetry history")
     p.add_argument("--guards", action="store_true",
                    help="run guard-instrumented sections (currently "
                         "stream) under photon_ml_tpu.analysis.guards: "
@@ -1608,6 +1649,20 @@ def main(argv: list[str] | None = None) -> int:
         # Single-section invocation: emit just that section's slice
         # (still one JSON object on the last line).
         out["section"] = sections[0]
+    if args.history_dir:
+        # One envelope file per run (ISSUE 8 trajectory gating): the
+        # record the last stdout line carries, plus the schema/argv
+        # header `telemetry history` consumes.  Filename sorts by
+        # wall-clock so directory order is round order.
+        os.makedirs(args.history_dir, exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        path = os.path.join(args.history_dir,
+                            f"bench_{stamp}_{os.getpid()}.json")
+        with open(path, "w") as f:
+            json.dump({"schema": 1, "kind": "bench_record",
+                       "ts": time.time(), "argv": sys.argv[1:],
+                       "rc": 0, "record": out}, f)
+        print(f"history record appended: {path}", file=sys.stderr)
     print(json.dumps(out))
     return 0
 

@@ -88,6 +88,10 @@ RULES = {
         "time.time() used in duration arithmetic; wall clock steps "
         "under NTP/suspend — use time.monotonic()/time.perf_counter()"
     ),
+    "metric-name": (
+        "telemetry counter/gauge/histogram registered under a name "
+        "that is not a dotted lowercase identifier (namespace.metric)"
+    ),
     "slow-unmarked": (
         "test measured slower than the threshold lacks "
         "@pytest.mark.slow"
@@ -831,6 +835,47 @@ def check_naked_clock(ctx: _FileContext):
 
 
 # ---------------------------------------------------------------------------
+# Rule: metric-name
+# ---------------------------------------------------------------------------
+
+# Dotted lowercase identifier with at least two segments
+# ("namespace.metric"): the report, the bench telemetry block, and the
+# history extractor all address metrics by dotted path, so a flat or
+# mixed-case name silently falls out of every dashboard slice.
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+_METRIC_FNS = ("count", "gauge", "observe")
+# Receivers that identify the metrics registry at a call site: the
+# module-level helpers, the conventional session handles, and the
+# session's own methods.  Keyed narrowly so ``line.count(",")`` (str)
+# or a container's ``.count`` can never false-positive.
+_METRIC_RECEIVERS = ("telemetry", "t", "tel", "self", "self._t")
+
+
+def check_metric_name(ctx: _FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _METRIC_FNS):
+            continue
+        recv = _dotted(func.value)
+        if recv not in _METRIC_RECEIVERS:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue            # dynamic names: the caller's contract
+        if not _METRIC_NAME_RE.match(arg.value):
+            yield Violation(
+                ctx.path, node.lineno, "metric-name",
+                f"metric name {arg.value!r} is not a dotted lowercase "
+                "identifier (want namespace.metric, e.g. "
+                "'solver.sweeps'); flat or mixed-case names fall out "
+                "of the report/history metric paths")
+
+
+# ---------------------------------------------------------------------------
 # Rule: slow-unmarked (repo-level: needs the recorded durations)
 # ---------------------------------------------------------------------------
 
@@ -918,6 +963,7 @@ _FILE_CHECKERS = (
     check_accumulator_dtype,
     check_env_read,
     check_naked_clock,
+    check_metric_name,
 )
 
 

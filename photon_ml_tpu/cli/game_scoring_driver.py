@@ -136,7 +136,10 @@ def run(config: ScoringConfig, log: RunLogger | None = None) -> dict:
     # the training driver): spans/heartbeats land in scoring_log.jsonl,
     # trace.json (telemetry=trace) in telemetry_dir.
     with (log or RunLogger(os.path.join(out_dir,
-                                        "scoring_log.jsonl"))) as log, \
+                                        "scoring_log.jsonl"),
+                           run_info={"driver": "game_scoring",
+                                     "telemetry": config.telemetry})
+          ) as log, \
             telemetry.maybe_session(
                 config.telemetry, config.telemetry_dir or out_dir,
                 run_logger=log):

@@ -185,7 +185,10 @@ def run(config: TrainingConfig, log: RunLogger | None = None) -> dict:
     # shares the logger so spans/heartbeats land in the same JSONL the
     # report CLI reads.
     with (log or RunLogger(os.path.join(config.output_dir,
-                                        "run_log.jsonl"))) as log, \
+                                        "run_log.jsonl"),
+                           run_info={"driver": "game_training",
+                                     "telemetry": config.telemetry})
+          ) as log, \
             telemetry.maybe_session(
                 config.telemetry,
                 config.telemetry_dir or config.output_dir,

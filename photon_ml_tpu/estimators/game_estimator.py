@@ -957,6 +957,11 @@ class GameEstimator:
                 run_logger=run_logger), \
                 telemetry.span("estimator_fit", cat="phase"):
             prep = self._prepare(train)
+            # Device-memory data point right after dataset placement
+            # (ISSUE 8): the residency the HBM scale math sizes is the
+            # post-ETL, pre-solve footprint — phase boundaries alone
+            # would fold it into the fit-span sample.
+            telemetry.device_memory("datasets_placed")
             grid_points = self._grid_points()
             name = self._swept_coordinate_name()
             if (len(grid_points) > 1 and name is not None

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import convergence as _conv
 from photon_ml_tpu.game.coordinates import Coordinate
 
 logger = logging.getLogger(__name__)
@@ -224,6 +225,10 @@ def run_coordinate_descent(
             total = s if total is None else total + s
 
     history, validation_history = [], []
+    # Per-coordinate objective trajectory across sweeps (ISSUE 8): the
+    # delta between consecutive sweeps' final objective values is the
+    # CD-level convergence signal the reference logs per iteration.
+    prev_values: dict = {}
     for it in range(start_iteration, n_iterations):
         iter_diag = {}
         for name in update_sequence:
@@ -269,6 +274,19 @@ def run_coordinate_descent(
                 telemetry.count("cd.entities_retired", newly_retired)
             extra = ({} if newly_retired is None
                      else {"entities_newly_retired": newly_retired})
+            telemetry.count("cd.coordinate_updates")
+            # Objective delta vs this coordinate's previous sweep, and
+            # a convergence trace for resident solves (streaming
+            # coordinates emit their own — traces_convergence).
+            if hasattr(diag, "value") and jnp.ndim(diag.value) == 0:
+                value = float(diag.value)
+                if name in prev_values:
+                    delta = prev_values[name] - value
+                    extra["value_delta"] = round(delta, 8)
+                    telemetry.observe("cd.objective_delta", delta)
+                prev_values[name] = value
+                if not getattr(coord, "traces_convergence", False):
+                    _conv.solve_trace("resident", name, diag)
             logger.info(
                 "CD iter %d coordinate %s trained in %.2fs",
                 it + 1, name, elapsed,

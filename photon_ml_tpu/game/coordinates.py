@@ -44,6 +44,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import convergence as _conv
+from photon_ml_tpu.telemetry import device as _device
 from photon_ml_tpu.data.batch import Batch, DenseBatch
 from photon_ml_tpu.game.dataset import (
     EntityGrouping,
@@ -357,6 +359,12 @@ class Coordinate:
 
     name: str
 
+    # True when this coordinate's solver emits its own convergence
+    # telemetry (the host-driven streaming solvers / streamed REs);
+    # the CD loop then skips its resident-result trace so one solve
+    # never lands twice in the log (ISSUE 8).
+    traces_convergence = False
+
     def initial_coefficients(self):
         raise NotImplementedError
 
@@ -530,6 +538,8 @@ class ChunkedFixedEffectCoordinate(Coordinate):
     max_resident: int = 1
     prefetch_depth: int = 2
 
+    traces_convergence = True         # the streaming solvers emit live
+
     def __post_init__(self):
         from photon_ml_tpu.optim.base import OptimizerType
         from photon_ml_tpu.optim.streaming import ChunkedGLMObjective
@@ -586,7 +596,7 @@ class ChunkedFixedEffectCoordinate(Coordinate):
               else None)
         res = streaming_lbfgs_solve(
             self._obj.value_and_gradient, w0, self.config, l1_weight=l1,
-            value_fn=self._obj.value)
+            value_fn=self._obj.value, label=self.name)
         return res.w, res
 
     def train_swept(self, offsets: Array, reg, warm_start=None):
@@ -613,7 +623,7 @@ class ChunkedFixedEffectCoordinate(Coordinate):
         res = streaming_lbfgs_solve_swept(
             lambda W: self._obj.value_and_gradient_swept(W, reg),
             lambda W: self._obj.value_swept(W, reg),
-            W0, self.config, l1_weights=l1v,
+            W0, self.config, l1_weights=l1v, label=self.name,
         )
         return res.w, res
 
@@ -750,6 +760,8 @@ class StreamedRandomEffectCoordinate(Coordinate):
     tolerance, so retirement can never move the final model beyond
     solver tolerance.
     """
+
+    traces_convergence = True        # re_convergence events per sweep
 
     name: str
     grouping: EntityGrouping
@@ -950,6 +962,7 @@ class StreamedRandomEffectCoordinate(Coordinate):
                 list(warm_start)):
             self._adopt_warm_start(warm_start)
         rtol = self.retire_tolerance
+        woken = 0
         if self._solved_offsets is None:
             self._solved_offsets = off.copy()
         elif self.retirement and self.entities_retired:
@@ -962,6 +975,7 @@ class StreamedRandomEffectCoordinate(Coordinate):
             for b in range(len(self._active)):
                 woke = ((~self._active[b])
                         & (self._entity_max(b, drift) >= rtol))
+                woken += int(woke.sum())
                 self._active[b] |= woke
 
         specs = self._specs()
@@ -1003,6 +1017,15 @@ class StreamedRandomEffectCoordinate(Coordinate):
                         dev["x"], dev["labels"], dev["weights"],
                         dev["mask"], dev["offsets"], dev["w0"],
                     )
+                    # Device cost of bucket b's chunk-train program
+                    # (once per session per bucket shape; the program
+                    # just dispatched, so the relower is cache-warm).
+                    _device.maybe_capture(
+                        f"re_chunk_train.b{b}", _re_chunk_train,
+                        (opt.optimizer, opt.config, has_l1,
+                         opt.objective, dev["x"], dev["labels"],
+                         dev["weights"], dev["mask"], dev["offsets"],
+                         dev["w0"]), span="chunk_compute")
                     if pending is not None:
                         # Lag-1 harvest IS the dispatch backpressure:
                         # fetching chunk j-1's blocks fences its solve
@@ -1046,10 +1069,15 @@ class StreamedRandomEffectCoordinate(Coordinate):
             "entities_converged": int(sum((m & c).sum()
                                           for m, c in zip(solved, conv))),
             "entities_retired": retired_now,
+            "entities_woken": woken,
             "max_solver_iterations": max_iters,
             "chunks_streamed": len(specs),
         }
         self.last_diag = diag
+        # Per-sweep retirement/convergence dynamics event (ISSUE 8) —
+        # the trajectory the retirement machinery is judged on, not
+        # just end-state parity.
+        _conv.re_sweep(self.name, diag)
         return blocks_out, diag
 
     def retire_converged(self) -> int:
@@ -1064,6 +1092,10 @@ class StreamedRandomEffectCoordinate(Coordinate):
             newly += int(pend.sum())
             self._active[b] &= ~pend
             self._pending[b][:] = False
+        if newly:
+            # Commit-time event: re_sweep samples retirement as of
+            # sweep START, so the last sweep's commit lands here.
+            _conv.re_retirement(self.name, newly, self.entities_retired)
         return newly
 
     # -- score / export / variances -----------------------------------------

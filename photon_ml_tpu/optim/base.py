@@ -83,23 +83,46 @@ class StatesTracker:
     """Fixed-shape per-iteration history (reference
     ``OptimizationStatesTracker``): ``values[i]`` / ``grad_norms[i]`` hold
     the state after iteration i (slot 0 = initial point); ``count`` is the
-    number of valid slots.  Unwritten slots are NaN."""
+    number of valid slots.  Unwritten slots are NaN.
+
+    ``step_sizes[i]`` / ``ls_trials[i]`` (ISSUE 8 convergence traces)
+    record the accepted line-search step and the number of objective
+    trials iteration i paid (TRON records the step NORM and the inner-CG
+    iteration count instead — the analogous per-iteration cost).  Both
+    planes are optional pytree leaves: a ``None`` stays ``None`` through
+    every ``record``/``tree.map`` so pre-existing direct constructions
+    (the swept streaming solver assembles trackers by hand) keep their
+    treedef."""
 
     values: Array      # [max_iters + 1]
     grad_norms: Array  # [max_iters + 1]
     count: Array       # int32 scalar
+    step_sizes: Array | None = None  # [max_iters + 1] accepted α (TRON: ‖p‖)
+    ls_trials: Array | None = None   # [max_iters + 1] trials (TRON: CG iters)
 
     @staticmethod
     def create(max_iters: int) -> "StatesTracker":
         nan = jnp.full((max_iters + 1,), jnp.nan, jnp.float32)
         return StatesTracker(values=nan, grad_norms=nan,
-                             count=jnp.asarray(0, jnp.int32))
+                             count=jnp.asarray(0, jnp.int32),
+                             step_sizes=nan, ls_trials=nan)
 
-    def record(self, i: Array, value: Array, grad_norm: Array) -> "StatesTracker":
+    def record(self, i: Array, value: Array, grad_norm: Array,
+               step_size: Array | None = None,
+               ls_trials: Array | None = None) -> "StatesTracker":
+        def _set(plane, x):
+            if plane is None:
+                return None
+            if x is None:
+                return plane
+            return plane.at[i].set(
+                jnp.asarray(x, jnp.float32).astype(jnp.float32))
         return StatesTracker(
             values=self.values.at[i].set(value.astype(jnp.float32)),
             grad_norms=self.grad_norms.at[i].set(grad_norm.astype(jnp.float32)),
             count=jnp.maximum(self.count, i.astype(jnp.int32) + 1),
+            step_sizes=_set(self.step_sizes, step_size),
+            ls_trials=_set(self.ls_trials, ls_trials),
         )
 
 

@@ -133,8 +133,8 @@ def _orthant(w: Array, pg: Array) -> Array:
 def _line_search(
     value_fn, w: Array, f0: Array, pg: Array, d: Array,
     config: OptimizerConfig, xi: Array | None,
-) -> tuple[Array, Array, Array]:
-    """Backtracking Armijo; returns (w_new, f_new, ok).
+) -> tuple[Array, Array, Array, Array, Array]:
+    """Backtracking Armijo; returns (w_new, f_new, ok, alpha, trials).
 
     Sufficient-decrease test (Andrew & Gao's modified condition, which
     reduces to standard Armijo when there is no orthant projection):
@@ -170,11 +170,11 @@ def _line_search(
 
     alpha0 = jnp.asarray(1.0, w.dtype)
     w1, f1 = trial(alpha0)
-    _, w_new, f_new, _ = jax.lax.while_loop(
+    alpha, w_new, f_new, steps = jax.lax.while_loop(
         cond, body, (alpha0, w1, f1, jnp.asarray(0, jnp.int32))
     )
     ok = f_new < f0  # any strict decrease counts; stall otherwise
-    return w_new, f_new, ok
+    return w_new, f_new, ok, alpha, steps + 1
 
 
 def lbfgs_solve(
@@ -248,7 +248,7 @@ def lbfgs_solve(
         bad = jnp.vdot(pg, d_dir) >= 0.0
         d_dir = jnp.where(bad, -pg, d_dir)
 
-        w_new, f_new, ls_ok = _line_search(
+        w_new, f_new, ls_ok, alpha, trials = _line_search(
             full_value, c.w, c.f, pg, d_dir, config, xi
         )
         f_s_new, g_new = value_and_grad(w_new)
@@ -285,7 +285,9 @@ def lbfgs_solve(
         it = c.iteration + 1
 
         tracker = (
-            c.tracker.record(it, f_new, g_norm)
+            c.tracker.record(it, f_new, g_norm,
+                             step_size=jnp.where(ls_ok, alpha, 0.0),
+                             ls_trials=trials)
             if config.track_states
             else c.tracker
         )

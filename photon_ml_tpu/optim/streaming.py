@@ -44,6 +44,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import convergence as _conv
+from photon_ml_tpu.telemetry import device as _device
 from photon_ml_tpu.data.chunked_batch import ChunkedBatch
 from photon_ml_tpu.ops.objective import (
     GLMObjective,
@@ -450,6 +452,34 @@ class ChunkedGLMObjective:
             self.batch.store.assert_quiesced()
         self._cache.clear()
 
+    def capture_device_cost(self, w: Array) -> None:
+        """Explicit device-cost capture of the per-chunk value+gradient
+        program against chunk 0 (ISSUE 8).  Bench arms call this right
+        after warmup so the capture's AOT relower lands OUTSIDE the
+        timed sweeps; the in-sweep capture then finds the name already
+        resolved.  2-D ``w`` captures the swept program.  No-op without
+        an active telemetry session or with an empty batch."""
+        if telemetry.active() is None or self.batch.n_chunks == 0:
+            return
+        store = self.batch.store
+        if store is not None:
+            store.begin_read()
+        try:
+            b = _place_chunk(self.batch.chunk(0), self._mesh)
+        finally:
+            if store is not None:
+                store.end_read()
+        w = jnp.asarray(w, jnp.float32)
+        if w.ndim == 2:
+            _device.maybe_capture(
+                "chunk_vg_swept", _jit_vg_swept,
+                (self._inner, w, b, self._lane_map),
+                span="chunk_compute")
+        else:
+            _device.maybe_capture("chunk_vg", _jit_vg,
+                                  (self._inner, w, b),
+                                  span="chunk_compute")
+
     def _get(self, i: int):
         if i in self._cache:
             return self._cache[i]
@@ -489,7 +519,7 @@ class ChunkedGLMObjective:
                 nxt = self._get(i + 1)   # async transfer under compute
             yield cur
 
-    def _sweep(self, per_chunk, combine):
+    def _sweep(self, per_chunk, combine, cost=None):
         """Stream all chunks through ``per_chunk``, pipelined.
 
         Out-of-core batches add BACKPRESSURE: chunk i-1's accumulate is
@@ -500,20 +530,52 @@ class ChunkedGLMObjective:
         exists to bound.  On a device backend the chunk programs
         serialize on the accelerator anyway (the prefetch thread keeps
         transfers ahead regardless), so the fence costs a dispatch
-        bubble, not overlap."""
+        bubble, not overlap.
+
+        ``cost``: optional ``(name, jit_fn, chunk → args)`` device-cost
+        capture spec (ISSUE 8) — resolved once per session per name on
+        the FIRST chunk, right after its dispatch (the lowering cache is
+        then warm, so the capture relowers without a new compile
+        record)."""
         self.sweeps += 1
         telemetry.count("solver.sweeps")
         bounded = self.batch.store is not None
+        # Per-program dispatch times are only MEANINGFUL on the bounded
+        # (spilled) path, where the backpressure fence makes each
+        # iteration's wall time cover a chunk's device compute; the
+        # resident path dispatches asynchronously (tens of µs observed
+        # regardless of program cost), which would make the report's
+        # roofline fractions nonsense.
+        timed = (cost is not None and bounded
+                 and telemetry.active() is not None)
         acc = None
         with telemetry.span("sweep", cat="solver",
                             chunks=self.batch.n_chunks):
             for cur in self._chunk_stream():
                 # The span covers the backpressure fence too: that wait
                 # IS the previous chunk's device compute retiring.
+                t0 = time.perf_counter() if timed else None
                 with telemetry.span("chunk_compute", cat="device"):
                     if bounded and acc is not None:
                         jax.block_until_ready(acc)
                     out = per_chunk(cur)
+                newly_captured = False
+                if acc is None and cost is not None:
+                    name, fn, mk_args = cost
+                    newly_captured = _device.maybe_capture(
+                        name, fn, mk_args(cur), span="chunk_compute")
+                if timed and not newly_captured:
+                    # Per-PROGRAM dispatch histogram: the shared
+                    # "chunk_compute" span pools every chunk program's
+                    # dispatches, so the device report joins each
+                    # captured cost against this name-keyed measure
+                    # instead (review finding: a pooled mean overstates
+                    # the expensive program and understates the cheap
+                    # one whenever a solve runs both).  The capture
+                    # chunk — this program's first dispatch, which pays
+                    # the XLA compile — is excluded from the measure.
+                    telemetry.observe("device.dispatch_s." + cost[0],
+                                      time.perf_counter() - t0)
                 acc = out if acc is None else combine(acc, out)
         return acc
 
@@ -522,7 +584,9 @@ class ChunkedGLMObjective:
     def value(self, w: Array) -> Array:
         w = jnp.asarray(w, jnp.float32)
         val = self._sweep(lambda b: _jit_val(self._inner, w, b),
-                          lambda a, x: a + x)
+                          lambda a, x: a + x,
+                          cost=("chunk_value", _jit_val,
+                                lambda b: (self._inner, w, b)))
         val = val + self.objective.reg.l2_value(w)
         if self.objective.prior is not None:
             val = val + self.objective.prior.value(w)
@@ -532,7 +596,8 @@ class ChunkedGLMObjective:
         w = jnp.asarray(w, jnp.float32)
         f, g = self._sweep(
             lambda b: _jit_vg(self._inner, w, b),
-            lambda a, x: (a[0] + x[0], a[1] + x[1]))
+            lambda a, x: (a[0] + x[0], a[1] + x[1]),
+            cost=("chunk_vg", _jit_vg, lambda b: (self._inner, w, b)))
         reg = self.objective.reg
         f = f + reg.l2_value(w)
         g = g + reg.l2_gradient(w)
@@ -547,6 +612,9 @@ class ChunkedGLMObjective:
     def hessian_vector(self, w: Array, v: Array) -> Array:
         w = jnp.asarray(w, jnp.float32)
         v = jnp.asarray(v, jnp.float32)
+        # Auxiliary pass (not a line-search evaluation): the report's
+        # sweep-odometer reconciliation accounts it separately.
+        telemetry.count("solver.aux_sweeps")
         hv = self._sweep(lambda b: _jit_hvp(self._inner, w, v, b),
                          lambda a, x: a + x)
         hv = hv + self.objective.reg.l2_hessian_vector(v)
@@ -556,6 +624,7 @@ class ChunkedGLMObjective:
 
     def hessian_diagonal(self, w: Array) -> Array:
         w = jnp.asarray(w, jnp.float32)
+        telemetry.count("solver.aux_sweeps")
         hd = self._sweep(lambda b: _jit_hd(self._inner, w, b),
                          lambda a, x: a + x)
         hd = hd + self.objective.reg.l2_hessian_diagonal(w)
@@ -585,7 +654,9 @@ class ChunkedGLMObjective:
         W = jnp.asarray(W, jnp.float32)
         val = self._sweep(
             lambda b: _jit_val_swept(self._inner, W, b, self._lane_map),
-            lambda a, x: a + x)
+            lambda a, x: a + x,
+            cost=("chunk_value_swept", _jit_val_swept,
+                  lambda b: (self._inner, W, b, self._lane_map)))
         val = val + self._lane_reg(W, reg, "l2_value")
         if self.objective.prior is not None:
             val = val + jax.vmap(self.objective.prior.value)(W)
@@ -601,7 +672,9 @@ class ChunkedGLMObjective:
         W = jnp.asarray(W, jnp.float32)
         f, g = self._sweep(
             lambda b: _jit_vg_swept(self._inner, W, b, self._lane_map),
-            lambda a, x: (a[0] + x[0], a[1] + x[1]))
+            lambda a, x: (a[0] + x[0], a[1] + x[1]),
+            cost=("chunk_vg_swept", _jit_vg_swept,
+                  lambda b: (self._inner, W, b, self._lane_map)))
         f = f + self._lane_reg(W, reg, "l2_value")
         g = g + self._lane_reg(W, reg, "l2_gradient")
         if self.objective.prior is not None:
@@ -675,6 +748,7 @@ def streaming_lbfgs_solve(
     config: OptimizerConfig = OptimizerConfig(),
     l1_weight=None,
     value_fn=None,
+    label: str = "",
 ) -> OptimizationResult:
     """Host-driven L-BFGS / OWL-QN over an expensive (streamed)
     ``value_and_grad`` — the chunked mirror of ``optim.lbfgs
@@ -691,6 +765,14 @@ def streaming_lbfgs_solve(
     m = config.lbfgs_memory
     w = jnp.asarray(w0, jnp.float32)
     owlqn = l1_weight is not None
+    solver_name = "streaming_owlqn" if owlqn else "streaming_lbfgs"
+    # Sweep-odometer accounting (ISSUE 8): the initial fused evaluation
+    # below is the one data pass neither an ls_trial nor a recovery
+    # counter claims — one tick per solve closes the identity
+    #   solver.sweeps == streamed_solves + ls_trials
+    #                    + grad_recovery_sweeps + aux_sweeps
+    # that `telemetry report` reconciles.
+    telemetry.count("solver.streamed_solves")
     l1 = (jnp.broadcast_to(jnp.asarray(l1_weight, w.dtype), w.shape)
           if owlqn else None)
 
@@ -761,11 +843,18 @@ def streaming_lbfgs_solve(
         # stall-terminates rather than grinds.
         alpha = 1.0
         g_try = None
+        trials = 0
         for step in range(config.ls_max_steps + 1):
+            # The step the committed trial actually used: on a range
+            # exhaustion the loop tail shrinks ``alpha`` AFTER building
+            # w_try, so recording ``alpha`` there would understate the
+            # terminal stall-edge step by one shrink factor.
+            alpha_used = alpha
             w_try = w + alpha * d
             if owlqn:
                 w_try = jnp.where(jnp.sign(w_try) == xi, w_try, 0.0)
             telemetry.count("solver.ls_trials")
+            trials += 1
             if step == 0 or full_value is None:
                 f_try, g_try = full_value_grad(w_try)
             else:
@@ -780,6 +869,7 @@ def streaming_lbfgs_solve(
             # stall (no strict decrease — the common terminal
             # iteration) keeps the old state, so its gradient would be
             # discarded work: skip the pass and terminate below.
+            telemetry.count("solver.grad_recovery_sweeps")
             f_try, g_try = full_value_grad(w_try)
         elif g_try is None:
             g_try = g   # stalled: state is not committed below
@@ -806,7 +896,15 @@ def streaming_lbfgs_solve(
         telemetry.count("solver.iterations")
         if config.track_states:
             tracker = tracker.record(jnp.asarray(it, jnp.int32),
-                                     f_new, g_norm)
+                                     f_new, g_norm,
+                                     step_size=jnp.asarray(
+                                         alpha_used if ls_ok else 0.0),
+                                     ls_trials=jnp.asarray(
+                                         float(trials)))
+        _conv.iteration(solver_name, label, it, float(f_new),
+                        float(g_norm),
+                        step_size=(alpha_used if ls_ok else 0.0),
+                        ls_trials=trials)
         logger.info("streaming lbfgs iter %d: f=%.6f |pg|=%.3e%s", it,
                     float(f_new), float(g_norm),
                     " (stalled)" if stalled else "")
@@ -815,7 +913,7 @@ def streaming_lbfgs_solve(
         converged = conv or stalled
 
     pg_f = pgrad(g, w)
-    return OptimizationResult(
+    result = OptimizationResult(
         w=w,
         value=f,
         grad_norm=jnp.linalg.norm(pg_f),
@@ -823,6 +921,8 @@ def streaming_lbfgs_solve(
         converged=jnp.asarray(converged),
         tracker=tracker,
     )
+    _conv.solve_trace(solver_name, label, result)
+    return result
 
 
 def streaming_lbfgs_solve_swept(
@@ -831,6 +931,7 @@ def streaming_lbfgs_solve_swept(
     w0s: Array,
     config: OptimizerConfig = OptimizerConfig(),
     l1_weights=None,
+    label: str = "",
 ) -> OptimizationResult:
     """Host-driven batched-lane L-BFGS / OWL-QN: the whole λ grid as
     ONE streamed solve.
@@ -858,6 +959,11 @@ def streaming_lbfgs_solve_swept(
     W = jnp.asarray(w0s, jnp.float32)
     L, d = W.shape
     owlqn = l1_weights is not None
+    solver_name = ("streaming_owlqn_swept" if owlqn
+                   else "streaming_lbfgs_swept")
+    # One tick per solve for the initial fused sweep — see the
+    # odometer identity note in streaming_lbfgs_solve.
+    telemetry.count("solver.streamed_solves")
     if owlqn:
         l1 = jnp.asarray(l1_weights, W.dtype)
         l1 = jnp.broadcast_to(l1.reshape(L, -1), (L, d))
@@ -920,6 +1026,7 @@ def streaming_lbfgs_solve_swept(
         alpha = jnp.ones((L,), W.dtype)
         W_try = project(W + alpha[:, None] * D)
         telemetry.count("solver.ls_trials")
+        trials = 1
         F1, G1 = full_vg(W_try)
         ok = armijo(W_try, F1)
         accepted = ok | done
@@ -938,6 +1045,7 @@ def streaming_lbfgs_solve_swept(
             # sweep is shared; their rows are simply ignored).
             W_eval = jnp.where(accepted[:, None], W_acc, W_try)
             telemetry.count("solver.ls_trials")
+            trials += 1
             F_eval = full_val(W_eval)
             ok = armijo(W_eval, F_eval) & jnp.logical_not(accepted)
             W_acc = jnp.where(ok[:, None], W_try, W_acc)
@@ -1000,6 +1108,10 @@ def streaming_lbfgs_solve_swept(
         finished = active & (conv | stalled)
         converged = converged | finished
         done = done | finished
+        _conv.iteration(solver_name, label, it, F, g_norm,
+                        ls_trials=trials,
+                        lanes_active=int(jnp.sum(active)),
+                        lanes_done=int(jnp.sum(done)))
         logger.info(
             "streaming swept lbfgs iter %d: %d/%d lanes done, "
             "f_best=%.6f", it, int(jnp.sum(done)), L,
@@ -1011,7 +1123,7 @@ def streaming_lbfgs_solve_swept(
         count=(iters + 1 if config.track_states
                else jnp.zeros((L,), jnp.int32)),
     )
-    return OptimizationResult(
+    result = OptimizationResult(
         w=W,
         value=F,
         grad_norm=jnp.linalg.norm(PG_f, axis=-1),
@@ -1019,3 +1131,5 @@ def streaming_lbfgs_solve_swept(
         converged=converged,
         tracker=tracker,
     )
+    _conv.solve_trace(solver_name, label, result)
+    return result

@@ -55,10 +55,10 @@ def _boundary_tau(p: Array, d: Array, delta: Array) -> Array:
 
 def _steihaug_cg(
     hvp_w, g: Array, delta: Array, config: OptimizerConfig
-) -> tuple[Array, Array]:
+) -> tuple[Array, Array, Array]:
     """Approximately solve H p = −g within ‖p‖ ≤ Δ.
 
-    Returns (p, hit_boundary).  Stops on the forcing condition
+    Returns (p, hit_boundary, cg_iters).  Stops on the forcing condition
     ‖r‖ ≤ cg_tolerance·‖g‖, the iteration cap, or the trust boundary
     (negative curvature cannot occur for convex GLM objectives but is
     handled identically to the boundary case for safety).
@@ -104,7 +104,8 @@ def _steihaug_cg(
     )
     p, *_rest = jax.lax.while_loop(cond, body, init)
     boundary = _rest[-1]
-    return p, boundary
+    cg_iters = _rest[3]
+    return p, boundary, cg_iters
 
 
 @struct.dataclass
@@ -155,7 +156,7 @@ def tron_solve(
 
     def body(c: _TronCarry):
         hvp_w = lambda v: hvp(c.w, v)
-        p, _ = _steihaug_cg(hvp_w, c.g, c.delta, config)
+        p, _, cg_iters = _steihaug_cg(hvp_w, c.g, c.delta, config)
 
         f_new, g_new = value_and_grad(c.w + p)
         actual = c.f - f_new
@@ -193,7 +194,10 @@ def tron_solve(
         stalled = delta <= _DELTA_MIN
         it = c.iteration + 1
         tracker = (
-            c.tracker.record(it, f, g_norm) if config.track_states else c.tracker
+            c.tracker.record(it, f, g_norm,
+                             step_size=jnp.where(accept, p_norm, 0.0),
+                             ls_trials=cg_iters)
+            if config.track_states else c.tracker
         )
 
         keep = lambda new, old: jnp.where(c.done, old, new)

@@ -79,6 +79,17 @@ class _NullSpan:
 
 _NULL_SPAN = _NullSpan()
 
+
+def _reservoir_quantile(sorted_reservoir: list, q: float):
+    """Nearest-rank quantile of a SORTED reservoir — the one place the
+    bounded-error contract's rank arithmetic lives (``percentile()``
+    and the summary's p50/p95/p99 must agree by construction)."""
+    if not sorted_reservoir:
+        return None
+    idx = min(len(sorted_reservoir) - 1,
+              int(round(q * (len(sorted_reservoir) - 1))))
+    return sorted_reservoir[idx]
+
 # The active session (None = telemetry off).  Module-global by design:
 # instrumentation sites are deep library code (prefetch threads, chunk
 # stores) that cannot thread a handle through every call.
@@ -130,6 +141,15 @@ def heartbeat(stage: str, **fields) -> None:
         t.heartbeat(stage, **fields)
 
 
+def device_memory(tag: str | None = None) -> None:
+    """Sample the device-memory gauge now (ISSUE 8 device accounting).
+    Phase spans sample automatically at open/close; call this at extra
+    boundaries worth a data point (e.g. after dataset placement)."""
+    t = _ACTIVE
+    if t is not None:
+        t.sample_device_memory(tag)
+
+
 def thread_exception(stage: str, error: BaseException, **fields) -> None:
     """Immediate death event from a pipeline thread (written before
     the error rides the queue to the consumer)."""
@@ -162,6 +182,11 @@ class _Span:
             self._t._register_thread()
         self.depth = len(stack)
         stack.append(self)
+        if self.cat == "phase":
+            # Phase boundaries are the device-memory sampling points
+            # (ISSUE 8): cheap (a handful per run) and aligned with the
+            # phases the report attributes residency to.
+            self._t.sample_device_memory(self.name)
         self.ts = self._t.now()
         self.t0 = time.perf_counter()
         return self
@@ -170,6 +195,8 @@ class _Span:
         dur = time.perf_counter() - self.t0
         self._t._local.stack.pop()
         self._t._finish_span(self, dur, failed=exc_type is not None)
+        if self.cat == "phase":
+            self._t.sample_device_memory(self.name)
         return False
 
 
@@ -277,6 +304,9 @@ class Telemetry:
         self._thread_spans: dict = {}     # tid -> [span records]
         self._thread_names: dict = {}     # tid -> thread name
         self._instants: list = []         # (ts, tid, name, cat, args)
+        self._device_programs: dict = {}  # name -> cost dict (device.py)
+        self._dev_series: list = []       # (ts, bytes_in_use) samples
+        self._dev_memory_source: str | None = None
         self._sampler: _RssSampler | None = None
         self._bridge: _CompileBridge | None = None
         self._jax_stack: contextlib.ExitStack | None = None
@@ -351,7 +381,9 @@ class Telemetry:
                 with self._lock:
                     names = dict(self._thread_names)
                     instants = list(self._instants)
-                write_trace(path, merged, names, instants, rss_series)
+                    dev_series = list(self._dev_series)
+                write_trace(path, merged, names, instants, rss_series,
+                            device_series=dev_series)
                 self._log.event("trace_written", path=path,
                                 spans=len(merged))
         if self._owns_logger:
@@ -395,6 +427,53 @@ class Telemetry:
                 if len(h["reservoir"]) >= _RESERVOIR_CAP:
                     del h["reservoir"][::2]
                     h["stride"] *= 2
+
+    def percentile(self, name: str, q: float) -> float | None:
+        """Quantile ``q`` in [0, 1] of histogram ``name`` from its
+        bounded reservoir (ISSUE 8 satellite).
+
+        Error contract: the reservoir is a deterministic every-stride-th
+        subsample of the observation stream, so the estimate is the true
+        q-quantile of a subsample of size R ≥ _RESERVOIR_CAP/2 once the
+        stream exceeds the cap — rank error ≤ 1/R of the distribution
+        (≤ ~0.2 percentile points at the 1024 cap), pinned by the
+        bounded-error contract test.  None for an unknown name."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q!r} not in [0, 1]")
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None or not h["reservoir"]:
+                return None
+            res = sorted(h["reservoir"])
+        return _reservoir_quantile(res, q)
+
+    # -- device accounting (telemetry.device) --------------------------------
+
+    def sample_device_memory(self, tag: str | None = None) -> None:
+        """Device-memory gauge sample: backend ``memory_stats()`` or the
+        live-buffer census (see ``telemetry.device.memory_snapshot``).
+        Called at every phase-span boundary; a no-op when jax is absent
+        or the backend exposes nothing.  Each sample also lands as a
+        ``device_memory`` JSONL event carrying ``tag`` (the phase name,
+        or an explicit label like the estimator's ``datasets_placed``),
+        so a specific boundary's footprint is recoverable from the log
+        — the gauge and trace series are anonymous by construction."""
+        from photon_ml_tpu.telemetry import device as _device
+
+        snap = _device.memory_snapshot()
+        if snap is None:
+            return
+        self.gauge("device.bytes_in_use", snap["bytes_in_use"])
+        if "peak_bytes_in_use" in snap:
+            self.gauge("device.peak_bytes_in_use",
+                       snap["peak_bytes_in_use"])
+        with self._lock:
+            self._dev_memory_source = snap["source"]
+            self._dev_series.append((self.now(), snap["bytes_in_use"]))
+            if len(self._dev_series) > _RESERVOIR_CAP:
+                del self._dev_series[::2]
+        self._log.event("device_memory",
+                        **({"tag": tag} if tag else {}), **snap)
 
     # -- spans --------------------------------------------------------------
 
@@ -485,19 +564,46 @@ class Telemetry:
                       for k, v in sorted(self._gauges.items())}
             hists = {}
             for k, h in sorted(self._hists.items()):
+                res = sorted(h["reservoir"])
+
+                def pct(q, res=res):
+                    v = _reservoir_quantile(res, q)
+                    return None if v is None else round(v, 6)
+
                 hists[k] = {"count": h["count"],
                             "sum": round(h["sum"], 6),
                             "min": round(h["min"], 6),
                             "max": round(h["max"], 6),
-                            "mean": round(h["sum"] / max(h["count"], 1), 6)}
+                            "mean": round(h["sum"] / max(h["count"], 1), 6),
+                            "p50": pct(0.50), "p95": pct(0.95),
+                            "p99": pct(0.99)}
             spans = {k: {"cat": st["cat"], "count": st["count"],
                          "total_s": round(st["total_s"], 6),
                          "min_s": round(st["min_s"], 6),
                          "max_s": round(st["max_s"], 6)}
                      for k, st in sorted(self._span_stats.items())}
-        return {"mode": self.mode, "counters": counters, "gauges": gauges,
-                "histograms": hists, "spans": spans,
-                "derived": self._derived(counters, spans)}
+            programs = {k: v for k, v in self._device_programs.items()
+                        if v is not None}
+            dev_source = self._dev_memory_source
+            dev_samples = len(self._dev_series)
+        out = {"mode": self.mode, "counters": counters, "gauges": gauges,
+               "histograms": hists, "spans": spans,
+               "derived": self._derived(counters, spans)}
+        if programs or dev_source is not None:
+            device = {}
+            if programs:
+                device["programs"] = programs
+            if dev_source is not None:
+                device["memory"] = {
+                    "source": dev_source, "samples": dev_samples,
+                    **{k: gauges[f"device.{k}"]["last"]
+                       for k in ("bytes_in_use", "peak_bytes_in_use")
+                       if f"device.{k}" in gauges},
+                    **{f"{k}_max": gauges[f"device.{k}"]["max"]
+                       for k in ("bytes_in_use",)
+                       if f"device.{k}" in gauges}}
+            out["device"] = device
+        return out
 
 
 def start(mode: str, telemetry_dir: str | None = None, run_logger=None,
@@ -521,7 +627,7 @@ def start(mode: str, telemetry_dir: str | None = None, run_logger=None,
 
         path = (os.path.join(telemetry_dir, "run_log.jsonl")
                 if telemetry_dir else None)
-        run_logger = RunLogger(path)
+        run_logger = RunLogger(path, run_info={"telemetry": mode})
         owns = True
     t = Telemetry(mode, run_logger, telemetry_dir,
                   heartbeat_s=heartbeat_s, rss_period_s=rss_period_s,

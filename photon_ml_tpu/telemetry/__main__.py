@@ -1,9 +1,20 @@
-"""Telemetry CLI: ``python -m photon_ml_tpu.telemetry report <log>``.
+"""Telemetry CLI: ``python -m photon_ml_tpu.telemetry <report|history>``.
 
-Prints the per-phase / stage-span / overlap / reconciliation report
-for a run's ``run_log.jsonl`` (see ``telemetry.report``); the last
-stdout line is one machine-parseable JSON object and the exit code is
-1 when the span-vs-wall-clock reconciliation check fails.
+``report <log>`` prints the per-phase / stage-span / overlap /
+convergence / device / reconciliation report for a run's
+``run_log.jsonl`` (see ``telemetry.report``); exit code 1 when the
+span-vs-wall-clock reconciliation or the convergence sweep-odometer
+check fails.
+
+``history <dir-or-files...>`` ingests bench round records (the repo's
+``BENCH_r*.json`` wrappers, raw bench JSON-last-line records, or
+``bench.py --history-dir`` envelopes) into per-section metric
+trajectories and gates them against a rolling baseline (see
+``telemetry.history``); exit code 1 on any regression or on any round
+with a nonzero rc.
+
+Both subcommands print one machine-parseable JSON object as the last
+stdout line (the repo's CLI contract).
 """
 
 from __future__ import annotations
@@ -11,6 +22,11 @@ from __future__ import annotations
 import argparse
 import sys
 
+from photon_ml_tpu.telemetry.history import (
+    DEFAULT_TOLERANCE,
+    DEFAULT_WINDOW,
+    run_history,
+)
 from photon_ml_tpu.telemetry.report import report
 
 
@@ -21,11 +37,30 @@ def main(argv=None) -> int:
     sub = p.add_subparsers(dest="cmd", required=True)
     rp = sub.add_parser(
         "report", help="per-phase wall-clock tables, prefetcher overlap "
-                       "efficiency, and the span reconciliation check")
+                       "efficiency, convergence + device accounting, "
+                       "and the reconciliation checks")
     rp.add_argument("log", help="path to a run_log.jsonl")
     rp.add_argument("--threshold", type=float, default=0.9,
                     help="reconciliation pass threshold (default 0.9)")
+    hp = sub.add_parser(
+        "history", help="bench-record trajectory: aggregate rounds, "
+                        "gate regressions against a rolling baseline")
+    hp.add_argument("paths", nargs="+",
+                    help="history directory or individual bench JSON "
+                         "files (BENCH_r*.json wrappers, raw records, "
+                         "or --history-dir envelopes)")
+    hp.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="relative worsening vs the rolling baseline "
+                         "that counts as a regression (default "
+                         f"{DEFAULT_TOLERANCE})")
+    hp.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help="rolling-baseline width in preceding rounds "
+                         f"(default {DEFAULT_WINDOW})")
     args = p.parse_args(argv)
+    if args.cmd == "history":
+        result = run_history(args.paths, tolerance=args.tolerance,
+                             window=args.window)
+        return 0 if result["ok"] else 1
     result = report(args.log, threshold=args.threshold)
     return 0 if result["ok"] else 1
 

@@ -1,0 +1,151 @@
+"""Solver convergence traces (ISSUE 8).
+
+The optimization tier's per-iteration story — loss, gradient norm,
+accepted step size, line-search trials — was locked inside device
+programs (``StatesTracker`` arrays) or host solver logs; end-state
+parity was the only convergence evidence.  "Parallel training of
+linear models without compromising convergence" (PAPERS.md) makes the
+per-iteration trace the first-class artifact of a solver comparison;
+this module emits it through the telemetry tier:
+
+- ``iteration(...)``: one ``convergence_iter`` JSONL event per
+  host-driven (streaming) solver iteration — live, so a killed run's
+  log still carries the partial trajectory.
+- ``solve_trace(...)``: one ``convergence_trace`` event per completed
+  solve, built from the ``StatesTracker`` planes (values / grad norms
+  / step sizes / line-search trials; per-lane for swept or vmapped
+  results with a small leading axis).
+- ``re_sweep(...)``: one ``re_convergence`` event per streamed
+  random-effect sweep — the solved/converged/retired/woken entity
+  dynamics the retirement machinery was previously judged on only via
+  end-state parity.
+
+All entry points are no-ops when telemetry is off (the module-global
+null-session contract: one read + early return, zero events).  The
+counters they maintain (``conv.iterations``, ``conv.solves``,
+``conv.solver_iterations``) are what ``telemetry report`` reconciles
+against the ``solver.sweeps`` data-pass odometer — see
+``report._convergence``: iteration counts and data passes can no
+longer drift apart unnoticed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from photon_ml_tpu import telemetry
+
+
+def _round_list(arr, ndigits: int = 8) -> list:
+    """Host list with bounded precision (JSONL size hygiene); NaN →
+    None so the line stays strict-JSON parseable."""
+    a = np.asarray(arr, np.float64)
+    out = []
+    for x in a.reshape(-1).tolist():
+        out.append(None if x != x else round(x, ndigits))
+    return out
+
+
+def iteration(solver: str, label: str, it: int, value, grad_norm,
+              step_size=None, ls_trials=None, lanes_active=None,
+              lanes_done=None) -> None:
+    """One host-driven solver iteration (streaming L-BFGS/OWL-QN).
+
+    ``value``/``grad_norm`` may be scalars or per-lane arrays (swept
+    solves); lane vectors are emitted in full — the grid is small by
+    construction (a handful of λ points)."""
+    t = telemetry.active()
+    if t is None:
+        return
+    t.count("conv.iterations")
+    fields = {"solver": solver, "label": label, "iteration": int(it)}
+    v = np.asarray(value, np.float64).reshape(-1)
+    g = np.asarray(grad_norm, np.float64).reshape(-1)
+    if v.size == 1:
+        fields["value"] = round(float(v[0]), 8)
+        fields["grad_norm"] = float(g[0])
+    else:
+        fields["values"] = _round_list(v)
+        fields["grad_norms"] = _round_list(g)
+    if step_size is not None:
+        fields["step_size"] = float(np.asarray(step_size).reshape(-1)[0])
+    if ls_trials is not None:
+        fields["ls_trials"] = int(ls_trials)
+    if lanes_active is not None:
+        fields["lanes_active"] = int(lanes_active)
+    if lanes_done is not None:
+        fields["lanes_done"] = int(lanes_done)
+    t._log.event("convergence_iter", **fields)
+
+
+def solve_trace(solver: str, label: str, result) -> None:
+    """One completed solve's full trajectory from its tracker planes.
+
+    ``result`` is an ``OptimizationResult`` — scalar (one problem) or
+    lane-batched (leading axis L, the swept solvers).  Per-entity
+    vmapped random-effect results (thousands of lanes) should NOT come
+    through here; their aggregate rides ``re_sweep``/``cd_coordinate``
+    events instead."""
+    t = telemetry.active()
+    if t is None:
+        return
+    iters = np.asarray(result.iterations).reshape(-1)
+    t.count("conv.solves")
+    t.count("conv.solver_iterations", int(iters.sum()))
+    fields = {"solver": solver, "label": label}
+    lanes = iters.size
+    if lanes == 1:
+        fields["iterations"] = int(iters[0])
+        fields["converged"] = bool(np.asarray(result.converged)
+                                   .reshape(-1)[0])
+    else:
+        fields["lanes"] = lanes
+        fields["iterations"] = [int(x) for x in iters.tolist()]
+        fields["converged"] = [bool(x) for x in
+                               np.asarray(result.converged)
+                               .reshape(-1).tolist()]
+    tracker = getattr(result, "tracker", None)
+    if tracker is not None:
+        count = np.asarray(tracker.count).reshape(-1)
+        c = int(count.max()) if count.size else 0
+        if c > 0:
+            vals = np.asarray(tracker.values, np.float64)
+            gns = np.asarray(tracker.grad_norms, np.float64)
+            # Lane-batched planes are [L, max_iters+1]; keep slots
+            # 0..c-1 (slot 0 = initial point).
+            fields["values"] = _round_list(vals[..., :c])
+            fields["grad_norms"] = _round_list(gns[..., :c])
+            if tracker.step_sizes is not None:
+                fields["step_sizes"] = _round_list(
+                    np.asarray(tracker.step_sizes)[..., :c], 6)
+            if tracker.ls_trials is not None:
+                fields["ls_trials"] = _round_list(
+                    np.asarray(tracker.ls_trials)[..., :c], 1)
+    t._log.event("convergence_trace", **fields)
+
+
+def re_retirement(coordinate: str, newly: int, total: int) -> None:
+    """Retirement COMMIT (the CD between-sweeps hook): ``re_sweep``
+    events sample the retired set as of sweep start, so the final
+    sweep's commit would otherwise appear in no event (review
+    finding)."""
+    t = telemetry.active()
+    if t is None:
+        return
+    t._log.event("re_retirement", coordinate=coordinate,
+                 entities_newly_retired=int(newly),
+                 entities_retired_total=int(total))
+
+
+def re_sweep(coordinate: str, diag: dict) -> None:
+    """One streamed random-effect sweep's entity dynamics (solved /
+    converged / retired / woken counts + the iteration high-water)."""
+    t = telemetry.active()
+    if t is None:
+        return
+    t.count("conv.re_sweeps")
+    keep = ("entities", "entities_solved", "entities_converged",
+            "entities_retired", "entities_woken",
+            "max_solver_iterations", "chunks_streamed")
+    fields = {k: int(diag[k]) for k in keep if k in diag}
+    t._log.event("re_convergence", coordinate=coordinate, **fields)

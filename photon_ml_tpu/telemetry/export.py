@@ -23,6 +23,7 @@ def _us(seconds: float) -> int:
 
 def trace_events(spans: list[dict], thread_names: dict,
                  instants: list, rss_series: list,
+                 device_series: list = (),
                  pid: int | None = None) -> list[dict]:
     """The traceEvents list (exposed separately for tests)."""
     pid = os.getpid() if pid is None else pid
@@ -53,16 +54,24 @@ def trace_events(spans: list[dict], thread_names: dict,
         events.append({"ph": "C", "name": "proc.rss_mb", "pid": pid,
                        "tid": 0, "ts": _us(ts),
                        "args": {"rss_mb": round(mb, 1)}})
+    for ts, nbytes in device_series:
+        # Device-memory counter track (ISSUE 8): phase-boundary samples
+        # of backend memory_stats / live-buffer census, in MB so the
+        # track shares a readable scale with proc.rss_mb.
+        events.append({"ph": "C", "name": "device.mem_mb", "pid": pid,
+                       "tid": 0, "ts": _us(ts),
+                       "args": {"mem_mb": round(nbytes / 1e6, 2)}})
     events.sort(key=lambda e: e.get("ts", 0))
     return events
 
 
 def write_trace(path: str, spans: list[dict], thread_names: dict,
-                instants: list, rss_series: list) -> None:
+                instants: list, rss_series: list,
+                device_series: list = ()) -> None:
     """Write ``trace.json`` atomically (tmp + rename — a killed run
     leaves the previous trace readable, never a truncated one)."""
     doc = {"traceEvents": trace_events(spans, thread_names, instants,
-                                       rss_series),
+                                       rss_series, device_series),
            "displayTimeUnit": "ms"}
     tmp = path + ".tmp"
     with open(tmp, "w") as f:

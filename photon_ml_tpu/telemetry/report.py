@@ -1,8 +1,12 @@
-"""Telemetry report: per-phase tables, overlap efficiency, and the
-span-vs-wall-clock reconciliation check over a ``run_log.jsonl``.
+"""Telemetry report: per-phase tables, overlap efficiency, convergence
+and device accounting, and the reconciliation checks over a
+``run_log.jsonl``.
 
 ``python -m photon_ml_tpu.telemetry report <run_log.jsonl>`` prints:
 
+- **Header**: the ``run_header`` event (run id, argv, jax version,
+  platform, telemetry mode) when present — absent in pre-ISSUE-8 logs,
+  which stay fully readable.
 - **Phases**: the RunLogger ``phase_start``/``phase_end`` wall-clock
   table (driver ETL / fit / save phases).
 - **Stage spans**: per-name duration stats from the
@@ -12,6 +16,18 @@ span-vs-wall-clock reconciliation check over a ``run_log.jsonl``.
   time the consumer was NOT blocked on the prefetch queue (1.0 = the
   disk+staging tier fully hidden under device compute) — plus producer
   stall and LRU hit/load counters.
+- **Convergence** (ISSUE 8): per-solver iteration totals from the
+  ``convergence_iter``/``convergence_trace`` events, streamed-RE
+  solved/retired dynamics, and the SWEEP-ODOMETER RECONCILIATION —
+  every streamed data pass must be claimed by exactly one accounting
+  bucket (``solver.sweeps == streamed_solves + ls_trials +
+  grad_recovery_sweeps + aux_sweeps``), so solver iteration counts and
+  data passes cannot drift apart unnoticed.  A violated identity fails
+  the report (rc 1).
+- **Device** (ISSUE 8): per-program FLOPs / bytes accessed from the
+  captured XLA cost analyses, the analytic roofline estimate, and the
+  measured per-dispatch span time it implies a fraction of — PERF.md's
+  hand math, emitted.
 - **Liveness**: heartbeat counts per stage and any thread_exception
   events (the hung-run forensic trail).
 - **Reconciliation**: for each thread with trace spans, the fraction
@@ -21,7 +37,8 @@ span-vs-wall-clock reconciliation check over a ``run_log.jsonl``.
   actually account for where the time went.
 
 The last stdout line is one machine-parseable JSON object (the repo's
-CLI contract); exit code is 1 when the reconciliation check fails.
+CLI contract); exit code is 1 when the span reconciliation OR the
+convergence sweep-odometer check fails.
 """
 
 from __future__ import annotations
@@ -44,6 +61,134 @@ def load_events(path: str) -> list[dict]:
             except json.JSONDecodeError:
                 out.append({"event": "_malformed_line"})
     return out
+
+
+def _convergence(events: list[dict], counters: dict) -> dict | None:
+    """Convergence reconciliation (ISSUE 8): per-solver iteration
+    totals and the sweep-odometer identity.
+
+    Every chunk sweep (``solver.sweeps``) is claimed by an accounting
+    bucket: the per-solve initial evaluation
+    (``solver.streamed_solves``), a line-search trial
+    (``solver.ls_trials``), a gradient-recovery pass
+    (``solver.grad_recovery_sweeps``), or an auxiliary pass
+    (``solver.aux_sweeps`` — Hessian diagonals/HVPs).  The check FAILS
+    when the claimed evaluations exceed the data passes (negative
+    ``unattributed`` — a solver claiming passes it never streamed is
+    impossible accounting, i.e. drift) or, with streamed solves
+    present, when the live per-iteration event count disagrees with
+    the ``solver.iterations`` counter (a solver iterating without
+    emitting, or vice versa — wiring drift).  POSITIVE unattributed
+    sweeps stay informational: direct objective evaluations outside
+    any solve (benches, notebooks, a final-loss log line) are
+    legitimate data passes no solve claims, and the report prints
+    their count so a creeping gap is still visible.
+
+    Returns None when the log carries no convergence signal at all
+    (pre-ISSUE-8 logs, telemetry off)."""
+    iters_by_solver: dict = {}
+    traces = 0
+    re_by_coord: dict = {}
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "convergence_iter":
+            key = (ev.get("solver", "?"), ev.get("label", ""))
+            iters_by_solver[key] = iters_by_solver.get(key, 0) + 1
+        elif kind == "convergence_trace":
+            traces += 1
+        elif kind == "re_convergence":
+            d = re_by_coord.setdefault(
+                ev.get("coordinate", "?"),
+                {"sweeps": 0, "solved": [], "retired": 0, "woken": 0})
+            d["sweeps"] += 1
+            d["solved"].append(ev.get("entities_solved"))
+            d["retired"] = max(d["retired"],
+                               ev.get("entities_retired") or 0)
+            d["woken"] += ev.get("entities_woken", 0)
+        elif kind == "re_retirement":
+            # Commit-time totals: re_convergence samples as of sweep
+            # start, so the LAST commit only appears here.
+            d = re_by_coord.setdefault(
+                ev.get("coordinate", "?"),
+                {"sweeps": 0, "solved": [], "retired": 0, "woken": 0})
+            d["retired"] = max(d["retired"],
+                               ev.get("entities_retired_total") or 0)
+    sweeps = counters.get("solver.sweeps")
+    solves = counters.get("solver.streamed_solves", 0)
+    ls = counters.get("solver.ls_trials", 0)
+    grad_rec = counters.get("solver.grad_recovery_sweeps", 0)
+    aux = counters.get("solver.aux_sweeps", 0)
+    if (not iters_by_solver and not traces and not re_by_coord
+            and sweeps is None):
+        return None
+    expected = solves + ls + grad_rec + aux
+    unattributed = (sweeps or 0) - expected
+    iter_events = sum(iters_by_solver.values())
+    ok = unattributed >= 0
+    if solves:
+        # The live per-iteration events and the counter must agree —
+        # an instrumented solver that iterates without emitting (or
+        # vice versa) is wiring drift.
+        ok = ok and iter_events == counters.get("solver.iterations", 0)
+    return {
+        "ok": ok,
+        "sweeps": sweeps or 0,
+        "streamed_solves": solves,
+        "ls_trials": ls,
+        "grad_recovery_sweeps": grad_rec,
+        "aux_sweeps": aux,
+        "unattributed_sweeps": unattributed,
+        "iterations": {f"{s}:{lbl}" if lbl else s: n
+                       for (s, lbl), n in sorted(iters_by_solver.items())},
+        "iteration_events": iter_events,
+        "solver_iterations_counter": counters.get("solver.iterations", 0),
+        "traces": traces,
+        "re": re_by_coord,
+    }
+
+
+def _device(summary: dict | None) -> dict | None:
+    """Device-accounting table: captured program costs joined against a
+    MEASURED per-dispatch time (the roofline estimate vs measured
+    comparison).
+
+    The measure of record is the per-program dispatch histogram
+    (``device.dispatch_s.<name>``) — the shared ``chunk_compute`` span
+    pools every chunk program's dispatches, so its mean is only used as
+    a fallback when exactly ONE captured program claims it (otherwise a
+    solve that runs both the fused and the value-only program would
+    overstate the expensive one's roofline fraction and understate the
+    cheap one's)."""
+    programs = ((summary or {}).get("device") or {}).get("programs")
+    if not programs:
+        return None
+    spans = (summary or {}).get("spans", {})
+    hists = (summary or {}).get("histograms", {})
+    span_claims: dict = {}
+    for cost in programs.values():
+        sp = cost.get("span")
+        if sp:
+            span_claims[sp] = span_claims.get(sp, 0) + 1
+    out = {}
+    for name, cost in sorted(programs.items()):
+        row = dict(cost)
+        measured_ms = None
+        h = hists.get(f"device.dispatch_s.{name}")
+        if h and h.get("count"):
+            measured_ms = 1e3 * h["mean"]
+        else:
+            st = spans.get(cost.get("span", ""), None)
+            if (st and st["count"]
+                    and span_claims.get(cost.get("span")) == 1):
+                measured_ms = 1e3 * st["total_s"] / st["count"]
+        if measured_ms is not None:
+            row["measured_span_ms"] = round(measured_ms, 3)
+            est = cost.get("roofline_est_ms")
+            if est and measured_ms > 0:
+                row["roofline_fraction"] = round(est / measured_ms, 4)
+        out[name] = row
+    mem = ((summary or {}).get("device") or {}).get("memory")
+    return {"programs": out, **({"memory": mem} if mem else {})}
 
 
 def _phases(events: list[dict]) -> list[tuple[str, float]]:
@@ -106,6 +251,19 @@ def report(path: str, threshold: float = 0.9, out=None) -> dict:
             summary = ev         # last one wins (append-mode logs)
 
     w = lambda s="": print(s, file=out)
+    header = next((e for e in events if e.get("event") == "run_header"),
+                  None)
+    if header is not None:
+        w(f"Run {header.get('run_id', '?')} (schema "
+          f"{header.get('schema', '?')}): "
+          f"jax={header.get('jax', '-')} "
+          f"platforms={header.get('jax_platforms', '-')} "
+          f"telemetry={header.get('telemetry', '-')}")
+        argv = header.get("argv")
+        if argv:
+            w(f"  argv: {' '.join(str(a) for a in argv)}")
+        w()
+
     phases = _phases(events)
     if phases:
         w("Phases (run log):")
@@ -147,6 +305,46 @@ def report(path: str, threshold: float = 0.9, out=None) -> dict:
             w(f"  chunk source: {hits or 0} LRU window hits, "
               f"{loads or 0} disk loads, "
               f"{counters.get('store.rebuilds', 0)} rebuilds")
+        w()
+
+    conv = _convergence(events, counters)
+    if conv is not None:
+        w("Convergence:")
+        for key, n in conv["iterations"].items():
+            w(f"  {key}: {n} iterations")
+        for coord, d in conv["re"].items():
+            solved = [s for s in d["solved"] if s is not None]
+            w(f"  re '{coord}': {d['sweeps']} sweeps, solved/sweep "
+              f"{solved}, retired {d['retired']}, woken {d['woken']}")
+        w(f"  sweep odometer: {conv['sweeps']} data passes = "
+          f"{conv['streamed_solves']} solve inits + "
+          f"{conv['ls_trials']} ls trials + "
+          f"{conv['grad_recovery_sweeps']} grad recoveries + "
+          f"{conv['aux_sweeps']} aux + "
+          f"{conv['unattributed_sweeps']} unattributed "
+          f"-> {'PASS' if conv['ok'] else 'FAIL'}")
+        w()
+
+    device = _device(summary)
+    if device is not None:
+        w("Device programs (XLA cost analysis):")
+        w(f"  {'program':<22} {'GFLOPs':>9} {'MB':>9} {'roof_ms':>8} "
+          f"{'meas_ms':>8} {'frac':>6}")
+        for name, row in device["programs"].items():
+            gf = (row.get("flops") or 0.0) / 1e9
+            mb = (row.get("bytes_accessed") or 0.0) / 1e6
+            est = row.get("roofline_est_ms")
+            meas = row.get("measured_span_ms")
+            frac = row.get("roofline_fraction")
+            w(f"  {name:<22} {gf:>9.3f} {mb:>9.2f} "
+              f"{est if est is not None else '-':>8} "
+              f"{meas if meas is not None else '-':>8} "
+              f"{frac if frac is not None else '-':>6}")
+        mem = device.get("memory")
+        if mem:
+            w(f"  memory: {mem.get('bytes_in_use', 0)/1e6:.1f} MB in "
+              f"use ({mem.get('source')}, {mem.get('samples')} "
+              "phase-boundary samples)")
         w()
 
     torn = sum(1 for ev in events if ev.get("event") == "_malformed_line")
@@ -191,8 +389,17 @@ def report(path: str, threshold: float = 0.9, out=None) -> dict:
           "run died before close).")
         w()
 
+    if conv is not None and not conv["ok"]:
+        w("CONVERGENCE FAIL: solver iteration accounting does not "
+          "reconcile with the solver.sweeps odometer (see above).")
+        w()
+        ok = False
+
     result = {
         "ok": ok,
+        "run_id": (header or {}).get("run_id"),
+        "convergence": conv,
+        "device": device,
         "phases": {name: dur for name, dur in phases},
         "overlap_efficiency": overlap,
         "consumer_blocked_fraction": derived.get(

@@ -26,10 +26,41 @@ import contextlib
 import json
 import logging
 import os
+import sys
 import threading
 import time
+import uuid
 
 logger = logging.getLogger("photon_ml_tpu")
+
+# run_header schema version (ISSUE 8): bump when header fields change
+# meaning; report/history consumers key their parsing on it and must
+# tolerate ABSENCE entirely (pre-ISSUE-8 logs have no header).
+RUN_LOG_SCHEMA = 1
+
+
+def _runtime_info() -> dict:
+    """Best-effort runtime facts for the header: jax version/platform
+    only when jax is ALREADY imported (a header must never pull a
+    backend into a host-only driver), configured-platform string over
+    backend init for the same reason."""
+    info = {
+        "schema": RUN_LOG_SCHEMA,
+        "run_id": uuid.uuid4().hex[:12],
+        "argv": list(sys.argv),
+        "pid": os.getpid(),
+        "host_platform": sys.platform,
+    }
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        info["jax"] = getattr(jax, "__version__", None)
+        try:
+            platforms = jax.config.jax_platforms
+        except Exception:
+            platforms = None
+        if platforms:
+            info["jax_platforms"] = platforms
+    return info
 
 
 class RunLogger:
@@ -40,13 +71,22 @@ class RunLogger:
     use); drivers point it at ``<output_dir>/run_log.jsonl``.
     """
 
-    def __init__(self, path: str | None = None, mode: str = "w"):
+    def __init__(self, path: str | None = None, mode: str = "w",
+                 run_info: dict | None = None):
         """``mode="w"`` (default) makes each run's log self-contained —
         rerunning into the same output dir must not interleave events
-        from prior runs; pass ``"a"`` to accumulate deliberately."""
+        from prior runs; pass ``"a"`` to accumulate deliberately.
+
+        A schema-versioned ``run_header`` event (run id, argv, jax
+        version, platform — plus caller facts via ``run_info``, e.g.
+        the telemetry mode) is written as the FIRST JSONL line of every
+        fresh file; append mode skips it (the original header stands).
+        ``report``/``history`` consume it and tolerate its absence in
+        pre-existing logs."""
         self.path = path
         self._t0 = time.monotonic()
         self._f = None
+        self.run_info = dict(run_info or {})
         # Events arrive from pipeline threads too (telemetry heartbeats,
         # span merges): one lock keeps lines whole and the handle state
         # coherent (photon-lint unlocked-shared-write contract).
@@ -58,6 +98,9 @@ class RunLogger:
             # pre-ISSUE-7 driver bug) still lands its buffered tail on
             # interpreter exit.  Unregistered again in close().
             atexit.register(self.close)
+            if mode == "w":
+                self.event("run_header", **_runtime_info(),
+                           **self.run_info)
 
     def now(self) -> float:
         """Seconds on this logger's monotonic clock (the ``t`` field);

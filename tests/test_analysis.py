@@ -635,6 +635,52 @@ def test_slow_unmarked_accepts_module_pytestmark(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# metric-name (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+def test_metric_name_flags_flat_and_mixed_case():
+    vs = check_source(_src("""
+        from photon_ml_tpu import telemetry
+
+        def f(t):
+            telemetry.count("sweeps")
+            t.gauge("Queue.Depth", 3)
+            telemetry.observe("solver.lsTrials", 0.5)
+    """))
+    assert _rules(vs) == ["metric-name"] * 3
+    assert vs[0].line == 4 and "'sweeps'" in vs[0].message
+
+
+def test_metric_name_accepts_dotted_lowercase_and_skips_non_registry():
+    vs = check_source(_src("""
+        from photon_ml_tpu import telemetry
+
+        def f(t, line, items):
+            telemetry.count("solver.sweeps")
+            t.observe("prefetch.consumer_wait_s", 0.1)
+            t.gauge("store.lru.window_hits", 2)
+            line.count(",")             # str.count: not the registry
+            items.count(3)              # list.count: not the registry
+            telemetry.count(name_var)   # dynamic: caller's contract
+    """))
+    assert vs == []
+
+
+def test_metric_name_session_methods_and_waiver():
+    vs = check_source(_src("""
+        class Telemetry:
+            def emit(self):
+                self._t.count("BadName")
+                # photon-lint: disable=metric-name (legacy dashboard key)
+                self._t.gauge("LegacyKey", 1)
+                self.observe("also_flat", 2)
+    """))
+    assert _rules(vs) == ["metric-name", "metric-name"]
+    assert {v.line for v in vs} == {3, 6}   # the waivered line is clean
+
+
+# ---------------------------------------------------------------------------
 # the acceptance corpus + whole-repo gate + CLI contract
 # ---------------------------------------------------------------------------
 

@@ -122,8 +122,17 @@ def test_bench_stream_section_contract(tmp_path):
     assert tel["consumer_wait_s"] >= 0.0
     assert tel["store_loads"] + tel["store_hits"] > 0
     # Steady-state sweeps under telemetry still compile nothing (the
-    # guard budget and the bridge agree).
+    # guard budget and the bridge agree) — including across the ISSUE-8
+    # device-cost capture, whose AOT relower must not register.
     assert tel["compiles"] == 0, tel
+    # ISSUE 8 acceptance: each arm's JSON carries a device_cost block
+    # (FLOPs, bytes accessed, roofline estimate) for the per-chunk
+    # value+gradient program.
+    for arm in ("spilled", "resident"):
+        cost = s[arm]["device_cost"]
+        assert cost["flops"] > 0
+        assert cost["bytes_accessed"] > 0
+        assert cost["roofline_est_ms"] > 0
     # Chunks must dwarf the window (the RSS-bound claim's precondition)
     assert s["n_chunks"] >= 6 * s["host_max_resident"]
     # LRU bound held during the spilled arm's sweeps.
@@ -216,6 +225,67 @@ def test_bench_re_section_contract(tmp_path):
     assert r["score_parity_max"] < 1e-2
     assert r["sweep_time_ratio"] is not None
     assert rec["peak_rss_mb"]["re"] > 0
+
+
+def test_bench_history_dir_appends_envelope(tmp_path):
+    """`--history-dir` appends the run's JSON record as a
+    schema-versioned envelope file that `telemetry history` ingests
+    (ISSUE 8 satellite)."""
+    hist = tmp_path / "hist"
+    proc = _run_bench(tmp_path, "--section", "etl", "--budget-s", "60",
+                      "--history-dir", str(hist), *_TINY)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    files = sorted(os.listdir(hist))
+    assert len(files) == 1 and files[0].endswith(".json")
+    with open(hist / files[0]) as f:
+        env = json.load(f)
+    assert env["schema"] == 1
+    assert env["kind"] == "bench_record"
+    assert env["rc"] == 0
+    assert env["record"]["etl_grr_s"] is not None
+    # The gate ingests it cleanly.
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.telemetry", "history",
+         str(hist)], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    tail = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert tail["ok"] is True and tail["rounds"] == files
+
+
+def test_bench_history_trajectory_gate(tmp_path):
+    """The CI gating contract (ISSUE 8 satellite): `telemetry history`
+    over a synthetic two-round trajectory exits rc 0 clean and rc 1
+    with an injected 20% rows/s regression, naming the section/metric."""
+    hist = tmp_path / "hist"
+    hist.mkdir()
+
+    def write_round(name, rows_per_sec):
+        with open(hist / name, "w") as f:
+            json.dump({"schema": 1, "kind": "bench_record", "rc": 0,
+                       "record": {"stream": {
+                           "spilled": {"examples_per_sec": rows_per_sec},
+                           "pass_time_ratio": 1.02}}}, f)
+
+    def gate():
+        proc = subprocess.run(
+            [sys.executable, "-m", "photon_ml_tpu.telemetry", "history",
+             str(hist)], capture_output=True, text=True, timeout=120)
+        tail = json.loads(
+            [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+        return proc.returncode, tail
+
+    write_round("r01.json", 1_000_000.0)
+    write_round("r02.json", 1_020_000.0)
+    rc, tail = gate()
+    assert rc == 0 and tail["ok"] is True and tail["regressions"] == []
+
+    write_round("r03.json", 800_000.0)       # injected 20% regression
+    rc, tail = gate()
+    assert rc == 1 and tail["ok"] is False
+    assert tail["regressions"][0]["metric"] == (
+        "stream:stream.spilled.examples_per_sec")
+    assert tail["regressions"][0]["round"] == "r03.json"
 
 
 def test_bench_zero_budget_still_emits_json(tmp_path):

@@ -290,6 +290,35 @@ def test_tracker_records_monotone_history(rng):
     assert np.all(np.isnan(np.asarray(res.tracker.values)[k:]))
 
 
+def test_tracker_records_step_sizes_and_trials(rng):
+    """ISSUE 8: the tracker's per-iteration step-size and line-search
+    trial planes are populated by both resident solvers (TRON records
+    the step norm and inner-CG iteration count)."""
+    x, y, batch, obj = _logistic_problem(rng)
+    w0 = jnp.zeros(x.shape[1], jnp.float32)
+    cfg = OptimizerConfig(max_iters=50, tolerance=1e-6)
+    res = lbfgs_solve(lambda w: obj.value_and_gradient(w, batch), w0, cfg)
+    k = int(res.tracker.count)
+    assert k >= 2
+    steps = np.asarray(res.tracker.step_sizes)
+    trials = np.asarray(res.tracker.ls_trials)
+    # Slot 0 is the initial point: no step taken there.
+    assert np.isnan(steps[0]) and np.isnan(trials[0])
+    assert np.all(np.isfinite(steps[1:k])) and np.all(steps[1:k] >= 0)
+    assert np.all(trials[1:k] >= 1)
+    # Accepted α=1 full steps dominate a well-conditioned logistic fit.
+    assert np.any(steps[1:k] == 1.0)
+
+    res_t = tron_solve(
+        lambda w: obj.value_and_gradient(w, batch),
+        lambda w, v: obj.hessian_vector(w, v, batch), w0, cfg)
+    kt = int(res_t.tracker.count)
+    steps_t = np.asarray(res_t.tracker.step_sizes)[1:kt]
+    cg_t = np.asarray(res_t.tracker.ls_trials)[1:kt]
+    assert np.all(np.isfinite(steps_t)) and np.all(steps_t >= 0)
+    assert np.all(cg_t >= 1)              # every outer iter paid CG work
+
+
 def test_tron_rejects_l1():
     obj = GLMObjective(
         loss=losses.LOGISTIC,

@@ -104,7 +104,9 @@ def test_off_is_noop_and_emits_nothing(tmp_path):
     _fit(cobj, max_iters=2)
     log.close()
     events = read_run_log(str(tmp_path / "log.jsonl"))
-    assert events == []      # nothing touched the logger
+    # Only the RunLogger's own schema header — zero telemetry events
+    # (no spans, counters, convergence or device records).
+    assert [e["event"] for e in events] == ["run_header"]
 
 
 def test_maybe_session_off_and_nested(tmp_path):
@@ -563,7 +565,11 @@ def test_runlogger_context_manager_and_thread_safety(tmp_path):
     assert log._f is None                # context exit closed the file
     log.close()                          # idempotent (atexit fallback)
     events = read_run_log(path)
-    assert events[0]["event"] == "hello"
+    # Schema header first (ISSUE 8 satellite), then the event.
+    assert [e["event"] for e in events] == ["run_header", "hello"]
+    assert events[0]["schema"] == 1
+    assert events[0]["run_id"]
+    assert isinstance(events[0]["argv"], list)
     # Cross-thread event writes keep lines whole (the lock contract:
     # heartbeats arrive from pipeline threads).
     with RunLogger(path) as log:
@@ -576,7 +582,7 @@ def test_runlogger_context_manager_and_thread_safety(tmp_path):
         for th in threads:
             th.join()
     events = read_run_log(path)          # every line parses
-    assert len(events) == 200
+    assert len(events) == 201            # header + 200 thread events
 
 
 def test_runlogger_atexit_flush_fallback(tmp_path):
@@ -597,4 +603,538 @@ def test_runlogger_atexit_flush_fallback(tmp_path):
                           capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr
     events = read_run_log(path)
-    assert [e["event"] for e in events] == ["abandoned"]
+    assert [e["event"] for e in events] == ["run_header", "abandoned"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: histogram percentiles (bounded-error contract)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_percentile_bounded_error():
+    """The reservoir is a deterministic every-stride-th subsample; its
+    quantiles must track the stream's within the documented rank-error
+    bound once the stream far exceeds the cap (10000 obs vs cap 1024 →
+    reservoir ≥ 512 entries)."""
+    n = 10_000
+    rng = np.random.default_rng(17)
+    shuffled = rng.permutation(n).astype(float)
+    t = telemetry.start("metrics")
+    try:
+        for v in shuffled:
+            t.observe("test.shuffled", v)
+        for v in range(n):                       # arrival-ordered
+            t.observe("test.ordered", float(v))
+        for q, truth in ((0.5, 0.5 * (n - 1)), (0.95, 0.95 * (n - 1)),
+                         (0.99, 0.99 * (n - 1))):
+            # Ordered arrivals: systematic sample → near-exact.
+            assert abs(t.percentile("test.ordered", q) - truth) <= 0.01 * n
+            # Shuffled arrivals: uniform-ish subsample of ≥512 → a few
+            # percentile points of rank error.
+            assert abs(t.percentile("test.shuffled", q) - truth) <= 0.05 * n
+        assert t.percentile("no.such.metric", 0.5) is None
+        with pytest.raises(ValueError, match="quantile"):
+            t.percentile("test.ordered", 1.5)
+        summ = t.summary()
+        h = summ["histograms"]["test.ordered"]
+        assert h["p50"] is not None and h["p95"] is not None
+        assert h["p50"] <= h["p95"] <= h["p99"] <= h["max"]
+    finally:
+        t.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: device accounting
+# ---------------------------------------------------------------------------
+
+
+def test_device_cost_captured_on_streamed_fit(tmp_path):
+    """A metrics-mode streamed fit captures the per-chunk programs' XLA
+    cost analyses (FLOPs, bytes, roofline estimate) once per session,
+    emits device_cost events, and samples the device-memory gauge at
+    phase boundaries (live-buffer census on the CPU backend)."""
+    cobj = _spilled_objective(tmp_path)
+    log_path = str(tmp_path / "run_log.jsonl")
+    log = RunLogger(log_path)
+    t = telemetry.start("metrics", run_logger=log)
+    try:
+        with telemetry.span("fit", cat="phase"):
+            _fit(cobj)
+        summary = t.summary()
+    finally:
+        t.close()
+        log.close()
+    programs = summary["device"]["programs"]
+    assert {"chunk_vg", "chunk_value"} <= set(programs)
+    for name in ("chunk_vg", "chunk_value"):
+        cost = programs[name]
+        assert cost["flops"] > 0
+        assert cost["bytes_accessed"] > 0
+        assert cost["roofline_est_ms"] > 0
+        assert cost["span"] == "chunk_compute"
+    # Phase boundaries sampled the device-memory gauge (CPU → census).
+    mem = summary["device"]["memory"]
+    assert mem["source"] == "live_arrays"
+    assert mem["samples"] >= 2                   # fit open + close
+    assert summary["gauges"]["device.bytes_in_use"]["last"] >= 0
+    events = read_run_log(log_path)
+    costs = [e for e in events if e["event"] == "device_cost"]
+    assert {e["program"] for e in costs} >= {"chunk_vg", "chunk_value"}
+    # Each boundary sample lands as a TAGGED event, so a specific
+    # boundary's footprint is recoverable from the log.
+    mems = [e for e in events if e["event"] == "device_memory"]
+    assert mems and all(e["tag"] == "fit" for e in mems)
+
+
+def test_device_capture_compiles_nothing_new(tmp_path):
+    """The capture relowers a warm program: the compile bridge (and the
+    guard listener) must see ZERO new compile records — the
+    compile-budget contract with telemetry on."""
+    cobj = _spilled_objective(tmp_path)
+    w0 = jnp.zeros(D, jnp.float32)
+    _fit(cobj, max_iters=2)      # everything compiled, no session
+    t = telemetry.start("metrics")
+    try:
+        with count_compiles() as cc:
+            cobj.capture_device_cost(w0)
+        summary = t.summary()
+    finally:
+        t.close()
+    assert cc.count == 0, cc.programs
+    assert summary["counters"].get("jax.compiles", 0) == 0
+    assert summary["device"]["programs"]["chunk_vg"]["flops"] > 0
+
+
+def test_report_shows_device_section(tmp_path, capsys):
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+
+    cobj = _spilled_objective(tmp_path)
+    log_path = str(tmp_path / "run_log.jsonl")
+    log = RunLogger(log_path)
+    t = telemetry.start("trace", telemetry_dir=str(tmp_path),
+                        run_logger=log)
+    try:
+        with log.timed("fit"):
+            _fit(cobj)
+    finally:
+        t.close()
+        log.close()
+    rc = telemetry_main(["report", log_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Device programs (XLA cost analysis):" in out
+    tail = json.loads(out.strip().splitlines()[-1])
+    dev = tail["device"]["programs"]["chunk_vg"]
+    assert dev["bytes_accessed"] > 0
+    # The roofline estimate is joined against the measured span time.
+    assert dev["measured_span_ms"] > 0
+    assert dev["roofline_fraction"] is not None
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: convergence traces + sweep-odometer reconciliation
+# ---------------------------------------------------------------------------
+
+
+def test_convergence_events_reconcile_with_odometer(tmp_path, capsys):
+    """A metrics-mode streamed fit emits one convergence_iter event per
+    solver iteration and one convergence_trace per solve; the report's
+    sweep-odometer identity (sweeps == solves + ls trials + grad
+    recoveries + aux) holds exactly."""
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+
+    cobj = _spilled_objective(tmp_path)
+    log_path = str(tmp_path / "run_log.jsonl")
+    log = RunLogger(log_path)
+    t = telemetry.start("metrics", run_logger=log)
+    try:
+        _fit(cobj)
+        summary = t.summary()
+    finally:
+        t.close()
+        log.close()
+    c = summary["counters"]
+    events = read_run_log(log_path)
+    iters = [e for e in events if e["event"] == "convergence_iter"]
+    traces = [e for e in events if e["event"] == "convergence_trace"]
+    assert len(iters) == c["solver.iterations"] == c["conv.iterations"]
+    assert len(traces) == 1
+    tr = traces[0]
+    assert tr["solver"] == "streaming_lbfgs"
+    assert tr["iterations"] >= 1
+    # Tracker planes ride the trace: slot 0 (initial) + one per iter.
+    assert len(tr["values"]) == tr["iterations"] + 1
+    assert len(tr["step_sizes"]) == tr["iterations"] + 1
+    # Per-iteration events carry step size and trial count.
+    assert all("step_size" in e and e["ls_trials"] >= 1 for e in iters)
+    # The odometer identity, from the raw counters...
+    assert c["solver.sweeps"] == (c["solver.streamed_solves"]
+                                  + c["solver.ls_trials"]
+                                  + c.get("solver.grad_recovery_sweeps", 0)
+                                  + c.get("solver.aux_sweeps", 0))
+    # ...and through the report (rc 0, convergence ok).
+    rc = telemetry_main(["report", log_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "sweep odometer" in out and "PASS" in out
+    tail = json.loads(out.strip().splitlines()[-1])
+    conv = tail["convergence"]
+    assert conv["ok"] is True
+    assert conv["unattributed_sweeps"] == 0
+    assert conv["iterations"]["streaming_lbfgs"] == len(iters)
+
+
+def test_direct_evaluations_stay_informational(tmp_path, capsys):
+    """A direct objective evaluation outside any solve (a final-loss
+    log line, a notebook probe) is a legitimate pass no solve claims:
+    it must show as POSITIVE unattributed sweeps and keep rc 0 — only
+    impossible accounting (negative) fails the gate."""
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+
+    cobj = _spilled_objective(tmp_path)
+    log_path = str(tmp_path / "run_log.jsonl")
+    log = RunLogger(log_path)
+    t = telemetry.start("metrics", run_logger=log)
+    try:
+        res = _fit(cobj)
+        cobj.value(res.w)                      # the unclaimed pass
+    finally:
+        t.close()
+        log.close()
+    rc = telemetry_main(["report", log_path])
+    tail = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and tail["ok"] is True
+    assert tail["convergence"]["ok"] is True
+    assert tail["convergence"]["unattributed_sweeps"] == 1
+
+
+def test_report_fails_on_odometer_drift(tmp_path, capsys):
+    """A log whose counters claim more solver evaluations than data
+    passes (the drift this check exists to catch) fails the report at
+    rc 1 naming the convergence check."""
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+
+    log_path = str(tmp_path / "drift.jsonl")
+    events = [
+        {"t": 0.0, "event": "run_header", "schema": 1, "run_id": "x"},
+        {"t": 1.0, "event": "telemetry_summary", "mode": "metrics",
+         "counters": {"solver.sweeps": 3, "solver.streamed_solves": 1,
+                      "solver.ls_trials": 4, "solver.iterations": 4},
+         "gauges": {}, "histograms": {}, "spans": {}, "derived": {}},
+    ]
+    with open(log_path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    rc = telemetry_main(["report", log_path])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "CONVERGENCE FAIL" in out
+    tail = json.loads(out.strip().splitlines()[-1])
+    assert tail["ok"] is False
+    assert tail["convergence"]["ok"] is False
+    # 3 sweeps recorded, 1 + 4 = 5 claimed evaluations → 2 passes
+    # claimed by nobody's data.
+    assert tail["convergence"]["unattributed_sweeps"] == -2
+
+
+def test_e2e_swept_streamed_fit_metrics_convergence(tmp_path, capsys):
+    """THE ISSUE-8 acceptance run: an e2e swept streamed fit through
+    the training driver with telemetry=metrics emits convergence traces
+    whose per-solver iteration totals reconcile with the solver.sweeps
+    odometer in `telemetry report`."""
+    from photon_ml_tpu.cli import game_training_driver
+    from photon_ml_tpu.io.libsvm import write_libsvm
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+    from photon_ml_tpu.utils.synthetic import make_a1a_like
+
+    rows, labels, _ = make_a1a_like(n=1200, seed=5)
+    train_path = str(tmp_path / "a1a.libsvm")
+    write_libsvm(train_path, rows, np.where(labels > 0, 1, -1))
+    out_dir = str(tmp_path / "out")
+    config = {
+        "task_type": "LOGISTIC_REGRESSION",
+        "coordinates": [{
+            "name": "global", "kind": "FIXED_EFFECT",
+            "feature_shard": "features",
+            "optimizer": {"optimizer": "LBFGS", "reg_weight": 1.0,
+                          "max_iters": 12},
+        }],
+        "update_sequence": ["global"],
+        "input_path": train_path,
+        "validation_fraction": 0.2,
+        "output_dir": out_dir,
+        "evaluators": ["AUC"],
+        "reg_weight_grid": {"global": [3.0, 1.0, 0.3]},
+        "chunk_rows": 200,
+        "spill_dir": str(tmp_path / "spill"),
+        "host_max_resident": 2,
+        "telemetry": "metrics",
+    }
+    cfg_path = str(tmp_path / "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(config, f)
+    game_training_driver.main(["--config", cfg_path])
+    assert telemetry.active() is None
+
+    log_path = os.path.join(out_dir, "run_log.jsonl")
+    events = read_run_log(log_path)
+    # Header first (schema-versioned), convergence events present.
+    assert events[0]["event"] == "run_header"
+    assert events[0]["schema"] == 1
+    assert events[0]["telemetry"] == "metrics"
+    iters = [e for e in events if e["event"] == "convergence_iter"]
+    assert iters and all(e["solver"] == "streaming_lbfgs_swept"
+                         and e["label"] == "global" for e in iters)
+    assert all(len(e["values"]) == 3 for e in iters)   # per-lane
+    traces = [e for e in events if e["event"] == "convergence_trace"]
+    assert len(traces) == 1 and traces[0]["lanes"] == 3
+
+    rc = telemetry_main(["report", log_path])
+    out = capsys.readouterr().out
+    tail = json.loads(out.strip().splitlines()[-1])
+    assert rc == 0 and tail["ok"] is True
+    conv = tail["convergence"]
+    assert conv["ok"] is True
+    assert conv["sweeps"] > 0
+    assert conv["unattributed_sweeps"] == 0
+    assert conv["iterations"]["streaming_lbfgs_swept:global"] == len(iters)
+    assert tail["run_id"] == events[0]["run_id"]
+
+
+def test_scoring_driver_trace_mode_report(tmp_path, capsys):
+    """ISSUE 8 satellite: `telemetry report` over a trace-mode log
+    produced by the SCORING driver e2e (only the training driver path
+    was reconciliation-tested before)."""
+    from photon_ml_tpu.cli import game_scoring_driver, game_training_driver
+    from photon_ml_tpu.io.libsvm import write_libsvm
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+    from photon_ml_tpu.utils.synthetic import make_a1a_like
+
+    rows, labels, _ = make_a1a_like(n=1000, seed=7)
+    train_path = str(tmp_path / "a1a.libsvm")
+    write_libsvm(train_path, rows, np.where(labels > 0, 1, -1))
+    out_dir = str(tmp_path / "out")
+    config = {
+        "task_type": "LOGISTIC_REGRESSION",
+        "coordinates": [{
+            "name": "global", "kind": "FIXED_EFFECT",
+            "feature_shard": "features",
+            "optimizer": {"reg_weight": 1.0, "max_iters": 10},
+        }],
+        "update_sequence": ["global"],
+        "input_path": train_path,
+        "output_dir": out_dir,
+        "evaluators": [],
+    }
+    cfg_path = str(tmp_path / "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(config, f)
+    game_training_driver.main(["--config", cfg_path])
+
+    score_dir = tmp_path / "scored"
+    sc = {"input_path": train_path,
+          "model_dir": os.path.join(out_dir, "model"),
+          "output_path": str(score_dir / "scores.npz"),
+          "evaluators": ["AUC"],
+          "score_chunk_rows": 128,
+          "spill_dir": str(tmp_path / "spill_sc"),
+          "host_max_resident": 2,
+          "telemetry": "trace"}
+    sc_path = str(tmp_path / "sc.json")
+    with open(sc_path, "w") as f:
+        json.dump(sc, f)
+    game_scoring_driver.main(["--config", sc_path])
+    assert telemetry.active() is None
+
+    log_path = str(score_dir / "scoring_log.jsonl")
+    assert os.path.exists(str(score_dir / "trace.json"))
+    events = read_run_log(log_path)
+    assert events[0]["event"] == "run_header"
+    assert events[0]["driver"] == "game_scoring"
+    assert events[0]["telemetry"] == "trace"
+    spans = [e for e in events if e["event"] == "span"]
+    names = {s["name"] for s in spans}
+    assert {"transform_streamed", "score_pass", "chunk_compute"} <= names
+
+    rc = telemetry_main(["report", log_path])
+    out = capsys.readouterr().out
+    tail = json.loads(out.strip().splitlines()[-1])
+    assert rc == 0 and tail["ok"] is True
+    assert tail["reconciliation"] >= 0.9
+    assert tail["phases"]["transform_streamed"] > 0
+    assert tail["counters"]["score.passes"] == 1
+
+
+def test_streamed_re_emits_convergence_dynamics(tmp_path):
+    """The streamed random-effect coordinate emits one re_convergence
+    event per sweep carrying the solved/converged/retired/woken entity
+    dynamics (previously judged only by end-state parity)."""
+    from photon_ml_tpu.data.normalization import NormalizationContext
+    from photon_ml_tpu.game.coordinates import (
+        build_streamed_random_effect_coordinate,
+    )
+    from photon_ml_tpu.game.dataset import GameDataset
+    from photon_ml_tpu.optim import OptimizerConfig
+
+    rng = np.random.default_rng(3)
+    n, p, E = 600, 4, 24
+    ids = rng.integers(0, E, n)
+    x = rng.normal(size=(n, p)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    ds = GameDataset(labels=y, features={"re": x},
+                     entity_ids={"u": ids})
+    obj = GLMObjective(loss=losses.LOGISTIC,
+                       reg=RegularizationContext.l2(1.0),
+                       norm=NormalizationContext.identity())
+    coord = build_streamed_random_effect_coordinate(
+        "u", ds, "re", obj,
+        config=OptimizerConfig(max_iters=30, tolerance=1e-4),
+        spill_dir=str(tmp_path / "spill_re"), chunk_entities=8,
+        host_max_resident=2, prefetch_depth=1, retirement=True)
+
+    log_path = str(tmp_path / "run_log.jsonl")
+    log = RunLogger(log_path)
+    t = telemetry.start("metrics", run_logger=log)
+    try:
+        off = jnp.zeros(n, jnp.float32)
+        w, diag = coord.train(off, None)
+        coord.retire_converged()               # sweep 1: no candidates yet
+        w, diag = coord.train(off, w)
+        coord.retire_converged()               # static offsets → retire
+        w, diag = coord.train(off, w)
+        summary = t.summary()
+    finally:
+        t.close()
+        log.close()
+    assert "entities_woken" in diag
+    events = read_run_log(log_path)
+    res = [e for e in events if e["event"] == "re_convergence"]
+    assert len(res) == 3
+    assert res[0]["coordinate"] == "u"
+    assert res[0]["entities_solved"] == E
+    assert res[2]["entities_retired"] > 0      # third sweep saw frozen
+    assert summary["counters"]["conv.re_sweeps"] == 3
+    # Device cost of the per-bucket chunk-train program was captured.
+    programs = summary.get("device", {}).get("programs", {})
+    assert any(k.startswith("re_chunk_train.b") for k in programs)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: bench-history trajectory gating
+# ---------------------------------------------------------------------------
+
+
+def _write_round(path, record, rc=0, wrapper=False):
+    with open(path, "w") as f:
+        if wrapper:
+            json.dump({"n": 1, "cmd": "bench", "rc": rc,
+                       "tail": "", "parsed": record}, f)
+        else:
+            json.dump({"schema": 1, "kind": "bench_record",
+                       "argv": ["--section", "stream"], "rc": rc,
+                       "record": record}, f)
+
+
+def _stream_record(rows_per_sec, ratio=1.0):
+    return {"stream": {"spilled": {"examples_per_sec": rows_per_sec},
+                       "pass_time_ratio": ratio}}
+
+
+def test_history_clean_then_regressed(tmp_path, capsys):
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+
+    hist = tmp_path / "hist"
+    hist.mkdir()
+    _write_round(str(hist / "r01.json"), _stream_record(1000.0))
+    _write_round(str(hist / "r02.json"), _stream_record(1040.0))
+    rc = telemetry_main(["history", str(hist)])
+    out = capsys.readouterr().out
+    tail = json.loads(out.strip().splitlines()[-1])
+    assert rc == 0 and tail["ok"] is True
+    assert tail["regressions"] == [] and tail["failed_rounds"] == []
+    traj = tail["trajectory"]["stream:stream.spilled.examples_per_sec"]
+    assert traj["values"] == [1000.0, 1040.0]
+
+    # Injected 20% rows/s regression in a third round → rc 1 naming
+    # the section/metric (the acceptance bar).
+    _write_round(str(hist / "r03.json"), _stream_record(816.0))
+    rc = telemetry_main(["history", str(hist)])
+    out = capsys.readouterr().out
+    tail = json.loads(out.strip().splitlines()[-1])
+    assert rc == 1 and tail["ok"] is False
+    regs = tail["regressions"]
+    assert len(regs) == 1
+    assert regs[0]["round"] == "r03.json"
+    assert regs[0]["metric"] == "stream:stream.spilled.examples_per_sec"
+    assert "REGRESSION" in out
+
+
+def test_history_flags_nonzero_rc_round(tmp_path, capsys):
+    """A round whose wrapper recorded a nonzero rc (the repo's own
+    BENCH_r05 shape: rc=124, parsed null) fails the gate by itself."""
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+
+    hist = tmp_path / "hist"
+    hist.mkdir()
+    _write_round(str(hist / "r01.json"), _stream_record(1000.0),
+                 wrapper=True)
+    _write_round(str(hist / "r02.json"), None, rc=124, wrapper=True)
+    # A torn wrapper that recorded "rc": null must flag, not crash.
+    _write_round(str(hist / "r03.json"), None, rc=None, wrapper=True)
+    rc = telemetry_main(["history", str(hist)])
+    out = capsys.readouterr().out
+    tail = json.loads(out.strip().splitlines()[-1])
+    assert rc == 1 and tail["ok"] is False
+    assert {(f["round"], f["rc"]) for f in tail["failed_rounds"]} == {
+        ("r02.json", 124), ("r03.json", None)}
+    assert "FAILED ROUND" in out
+
+
+def test_history_over_repo_bench_records(tmp_path, capsys):
+    """THE acceptance check on the real artifacts: the repo's
+    BENCH_r01..r04 trajectory is clean (rc 0); adding one synthetic
+    regressed round — and the real rc-124 r05 — exits rc 1 naming the
+    regressed section/metric."""
+    import shutil
+
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo_rounds = [os.path.join(root, f"BENCH_r0{i}.json")
+                   for i in range(1, 6)]
+    assert all(os.path.exists(p) for p in repo_rounds)
+
+    rc = telemetry_main(["history", *repo_rounds[:4]])
+    tail = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and tail["ok"] is True
+
+    # Repo rounds + a synthetic regressed round: the GRR throughput
+    # collapses 40% → rc 1, regression named, r05's rc=124 flagged too.
+    hist = tmp_path / "hist"
+    hist.mkdir()
+    for p in repo_rounds:
+        shutil.copy(p, str(hist / os.path.basename(p)))
+    _write_round(str(hist / "BENCH_r99.json"),
+                 {"value": 206592425.1 * 0.6, "step_ms_grr": 4.84})
+    rc = telemetry_main(["history", str(hist)])
+    out = capsys.readouterr().out
+    tail = json.loads(out.strip().splitlines()[-1])
+    assert rc == 1 and tail["ok"] is False
+    assert any(r["metric"] == "overall:value"
+               and r["round"] == "BENCH_r99.json"
+               for r in tail["regressions"])
+    assert any(fr["rc"] == 124 for fr in tail["failed_rounds"])
+
+
+def test_history_tolerates_garbage_files(tmp_path, capsys):
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+
+    hist = tmp_path / "hist"
+    hist.mkdir()
+    (hist / "bad.json").write_text("{not json")
+    _write_round(str(hist / "ok.json"), _stream_record(1000.0))
+    rc = telemetry_main(["history", str(hist)])
+    tail = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1                       # unreadable round = failed round
+    assert tail["failed_rounds"][0]["round"] == "bad.json"
+    assert "error" in tail["failed_rounds"][0]
