@@ -27,6 +27,11 @@ established by hand and a later tier could silently regress:
 - ``env-read``: no raw ``os.environ`` / ``os.getenv`` reads outside
   ``config.py``'s sanctioned registry (``config.read_env``) -- scatter
   env fallbacks are invisible configuration.
+- ``swallowed-exception``: an ``except`` whose body only
+  passes/continues/breaks/bare-returns — the failure is silently
+  discarded
+  (ISSUE 9: fault tolerance is only honest when every absorbed failure
+  is reported, handled with a real fallback, or waived with a reason).
 - ``slow-unmarked``: tests whose recorded tier-1 duration exceeds the
   threshold must carry ``@pytest.mark.slow`` so the tier-1 wall clock
   stops creeping (durations recorded once in
@@ -92,6 +97,11 @@ RULES = {
         "telemetry counter/gauge/histogram registered under a name "
         "that is not a dotted lowercase identifier (namespace.metric)"
     ),
+    "swallowed-exception": (
+        "except handler silently discards the failure (pass/continue/"
+        "break/bare return) without re-raising or logging — waiver "
+        "with reason for deliberate best-effort sites"
+    ),
     "slow-unmarked": (
         "test measured slower than the threshold lacks "
         "@pytest.mark.slow"
@@ -120,7 +130,7 @@ def _comments(source: str):
             if tok.type == tokenize.COMMENT:
                 out.append((tok.start[0], tok.string,
                             tok.line[: tok.start[1]].strip() == ""))
-    except (tokenize.TokenError, IndentationError):
+    except (tokenize.TokenError, IndentationError):  # photon-lint: disable=swallowed-exception (degrade to the comments seen so far, documented above)
         pass
     return out
 
@@ -876,6 +886,80 @@ def check_metric_name(ctx: _FileContext):
 
 
 # ---------------------------------------------------------------------------
+# Rule: swallowed-exception
+# ---------------------------------------------------------------------------
+
+# A call through any of these shapes counts as REPORTING the failure:
+#   * attribute calls whose method name is a logging/telemetry verb
+#     (logger.warning, log.event, telemetry.thread_exception, ...);
+#   * calls rooted at the logging/warnings modules (logging.warning,
+#     warnings.warn).
+_REPORTING_ATTRS = frozenset({
+    "debug", "info", "warning", "warn", "error", "exception",
+    "critical", "log", "event", "heartbeat", "thread_exception",
+})
+_REPORTING_ROOTS = ("logging", "warnings")
+
+
+def _handler_reports(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _REPORTING_ATTRS):
+                return True
+            d = _dotted(func)
+            if d and d.split(".")[0] in _REPORTING_ROOTS:
+                return True
+    return False
+
+
+def _handler_discards(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body does NOTHING with the failure:
+    only ``pass``/``continue``/``break``, bare or constant ``return``,
+    and constant expressions (docstrings).  A handler that computes a
+    fallback, retries with new state, or mutates anything is HANDLING
+    the error — different contract, not this rule's."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return):
+            if stmt.value is None or isinstance(stmt.value,
+                                                ast.Constant):
+                continue
+            return False
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue
+        return False
+    return True
+
+
+def check_swallowed_exception(ctx: _FileContext):
+    """An ``except`` that silently discards the failure hides it: the
+    run proceeds on wrong/partial state and the forensic trail has
+    nothing (ISSUE 9 — fault tolerance is only honest when every
+    absorbed failure is reported, handled with a real fallback, or
+    explicitly waived as best-effort).  The waiver's mandatory reason
+    IS the documentation of why silence is correct at that site."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _handler_discards(node) or _handler_reports(node):
+            continue
+        what = (_dotted(node.type) if node.type is not None
+                else "BaseException")
+        yield Violation(
+            ctx.path, node.lineno, "swallowed-exception",
+            f"except {what or '...'} handler silently discards the "
+            "failure: report it (logger/telemetry), handle it with a "
+            "real fallback, or waive with a reason documenting why "
+            "best-effort silence is correct here")
+
+
+# ---------------------------------------------------------------------------
 # Rule: slow-unmarked (repo-level: needs the recorded durations)
 # ---------------------------------------------------------------------------
 
@@ -964,6 +1048,7 @@ _FILE_CHECKERS = (
     check_env_read,
     check_naked_clock,
     check_metric_name,
+    check_swallowed_exception,
 )
 
 

@@ -71,7 +71,7 @@ class _CompileHandler(logging.Handler):
     def emit(self, record: logging.LogRecord) -> None:
         try:
             m = _COMPILE_RE.match(record.getMessage())
-        except Exception:       # a guard must never break the run
+        except Exception:  # photon-lint: disable=swallowed-exception (a guard must never break the run)
             return
         if m:
             # list.append is atomic under the GIL; compile records can

@@ -62,7 +62,7 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
             )
 
             _cc.reset_cache()
-        except Exception:
+        except Exception:  # photon-lint: disable=swallowed-exception (older jax without reset_cache; stale in-process handle is harmless)
             pass
     except Exception as e:  # older jax / read-only fs: run uncached
         logger.warning(

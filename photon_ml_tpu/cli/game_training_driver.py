@@ -183,11 +183,16 @@ def run(config: TrainingConfig, log: RunLogger | None = None) -> dict:
     # Context-managed logger lifecycle (ISSUE 7 satellite: the handle
     # used to leak on paths that bypassed close); the telemetry session
     # shares the logger so spans/heartbeats land in the same JSONL the
-    # report CLI reads.
+    # report CLI reads.  A RESUMED run appends: the stitched log (first
+    # run's torn tail + the resumed run's events) is the forensic
+    # record `telemetry report` reconciles segment by segment.
     with (log or RunLogger(os.path.join(config.output_dir,
                                         "run_log.jsonl"),
+                           mode=("a" if config.resume else "w"),
+                           header=True,
                            run_info={"driver": "game_training",
-                                     "telemetry": config.telemetry})
+                                     "telemetry": config.telemetry,
+                                     "resume": config.resume})
           ) as log, \
             telemetry.maybe_session(
                 config.telemetry,
@@ -287,6 +292,25 @@ def main(argv: list[str] | None = None) -> dict:
     parser.add_argument("--telemetry-dir", default=None,
                         help="override config telemetry_dir (default: "
                              "the output dir)")
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="override config checkpoint_dir: "
+                             "reliability checkpoints (CD sweep state, "
+                             "mid-solve solver state) land here")
+    parser.add_argument("--resume", action="store_true", default=None,
+                        help="resume from the most advanced checkpoint "
+                             "in checkpoint_dir (run log appends; "
+                             "analyze the stitched log with "
+                             "python -m photon_ml_tpu.telemetry report)")
+    parser.add_argument("--checkpoint-every-sweeps", type=int,
+                        default=None,
+                        help="override config checkpoint_every_sweeps: "
+                             "CD sweep-boundary snapshot cadence")
+    parser.add_argument("--checkpoint-every-solver-iters", type=int,
+                        default=None,
+                        help="override config "
+                             "checkpoint_every_solver_iters: streaming-"
+                             "solver mid-solve snapshot cadence (0 = "
+                             "sweep boundaries only)")
     args = parser.parse_args(argv)
     config = load_training_config(args.config)
     if args.output_dir:
@@ -305,6 +329,15 @@ def main(argv: list[str] | None = None) -> dict:
         config.telemetry = args.telemetry
     if args.telemetry_dir is not None:
         config.telemetry_dir = args.telemetry_dir
+    if args.checkpoint_dir is not None:
+        config.checkpoint_dir = args.checkpoint_dir
+    if args.resume is not None:
+        config.resume = args.resume
+    if args.checkpoint_every_sweeps is not None:
+        config.checkpoint_every_sweeps = args.checkpoint_every_sweeps
+    if args.checkpoint_every_solver_iters is not None:
+        config.checkpoint_every_solver_iters = (
+            args.checkpoint_every_solver_iters)
     # Re-validate with the overrides applied (the spill/streamed-RE
     # cross-field rules must hold for the effective config).
     config.validate()

@@ -216,6 +216,17 @@ class TrainingConfig:
     prior_weight: float = 1.0
     checkpoint_dir: str | None = None      # per-CD-iteration checkpoints
     resume: bool = False                   # resume from latest checkpoint
+    # Checkpoint cadence (reliability.checkpoint, ISSUE 9):
+    # checkpoint_every_sweeps gates the CD sweep-boundary snapshot
+    # (coefficients + score planes + streamed-RE retirement state; the
+    # final sweep always snapshots).  checkpoint_every_solver_iters > 0
+    # additionally snapshots the streaming L-BFGS/OWL-QN loop state
+    # (coefficients, (s,y,ρ) memory, swept lane buffers) every N solver
+    # iterations AND the CD position at every coordinate boundary, so a
+    # SIGKILL mid-solve resumes mid-solve; 0 keeps sweep-boundary-only
+    # checkpoints (the pre-round-14 behavior).
+    checkpoint_every_sweeps: int = 1
+    checkpoint_every_solver_iters: int = 0
     intercept: bool = True
     seed: int = 0
     # Score the validation set with every evaluator after each CD sweep
@@ -338,6 +349,11 @@ class TrainingConfig:
             )
         if self.resume and not self.checkpoint_dir:
             raise ValueError("resume requires checkpoint_dir")
+        if self.checkpoint_every_sweeps < 1:
+            raise ValueError("checkpoint_every_sweeps must be >= 1")
+        if self.checkpoint_every_solver_iters < 0:
+            raise ValueError(
+                "checkpoint_every_solver_iters must be >= 0")
         if not 0.0 <= self.validation_fraction < 1.0:
             raise ValueError("validation_fraction must be in [0, 1)")
         if self.n_iterations <= 0:
@@ -411,8 +427,6 @@ class TrainingConfig:
             self.tuning.validate()
             if self.reg_weight_grid:
                 raise ValueError("tuning and reg_weight_grid are exclusive")
-            if self.checkpoint_dir:
-                raise ValueError("tuning does not support checkpoint_dir")
             if not self.evaluators:
                 raise ValueError("tuning needs at least one evaluator")
             for name in self.tuning.reg_weight_ranges:

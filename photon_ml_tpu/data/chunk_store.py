@@ -45,10 +45,12 @@ Design:
 
 from __future__ import annotations
 
+import errno
 import hashlib
 import json
 import logging
 import os
+import shutil
 import struct
 import threading
 import zipfile
@@ -57,6 +59,8 @@ from collections import OrderedDict
 import numpy as np
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.reliability import faults as _faults
+from photon_ml_tpu.reliability import retry as _retry
 
 logger = logging.getLogger(__name__)
 
@@ -81,7 +85,7 @@ def release_free_heap() -> None:
         import ctypes
 
         ctypes.CDLL("libc.so.6").malloc_trim(0)
-    except Exception:   # non-glibc platforms: nothing to trim
+    except Exception:  # photon-lint: disable=swallowed-exception (non-glibc platforms: nothing to trim)
         pass
 
 
@@ -93,6 +97,85 @@ def resolve_spill_dir(spill_dir: str | None) -> str | None:
     from photon_ml_tpu.config import read_env
 
     return read_env("PHOTON_ML_TPU_SPILL_DIR") or None
+
+
+class ChunkStoreSpillError(RuntimeError):
+    """A spill write failed for CAPACITY, not transience: one
+    actionable error naming the spill dir, the bytes the chunk needed,
+    and the bytes the filesystem had free (ISSUE 9 satellite — the raw
+    ``OSError(ENOSPC)`` used to surface from the prefetch thread with
+    no context at all)."""
+
+    def __init__(self, spill_dir: str, bytes_needed: int,
+                 bytes_free: int | None):
+        self.spill_dir = spill_dir
+        self.bytes_needed = int(bytes_needed)
+        self.bytes_free = bytes_free
+        free = ("unknown" if bytes_free is None
+                else f"{bytes_free / 1e6:.1f} MB")
+        super().__init__(
+            f"chunk spill to {spill_dir!r} out of space: chunk needs "
+            f"~{bytes_needed / 1e6:.1f} MB, {free} free — free disk "
+            "space, point spill_dir/$PHOTON_ML_TPU_SPILL_DIR at a "
+            "larger volume, or raise chunk granularity "
+            "(chunk_rows / re_chunk_entities) to shrink per-chunk "
+            "spill size")
+
+
+def _free_bytes(path: str) -> int | None:
+    """Free bytes on the filesystem holding ``path`` (nearest existing
+    ancestor), or None when even that cannot be determined."""
+    p = os.path.abspath(path)
+    while p and not os.path.exists(p):
+        parent = os.path.dirname(p)
+        if parent == p:
+            break
+        p = parent
+    try:
+        return shutil.disk_usage(p).free
+    except OSError:  # photon-lint: disable=swallowed-exception (free-space probe is advisory; the spill error carries 'unknown')
+        return None
+
+
+# Spill dirs already warned about (degrade-to-resident is announced
+# ONCE per dir per process, not once per chunk build).
+_DEGRADED_DIRS: set[str] = set()
+_DEGRADED_LOCK = threading.Lock()
+
+
+def probe_spill_dir(spill_dir: str | None) -> str | None:
+    """``spill_dir`` if it is writable, else None — the documented
+    degradation for an unwritable spill dir: the caller falls back to
+    the resident (pre-round-8) path with ONE warning instead of dying
+    chunks deep into a build.  Streamed random effects, where the
+    store is the architecture rather than an optimization, must NOT
+    degrade — they keep calling the store directly and surface the
+    error."""
+    if spill_dir is None:
+        return None
+    # Unique probe name: spill dirs are SHARED across runs by design
+    # (content-addressed warm reuse), so a fixed name would let two
+    # concurrent probes race on the remove and spuriously degrade a
+    # healthy dir (review finding).
+    probe = os.path.join(spill_dir, "chunks",
+                         f".probe-{os.getpid()}-{threading.get_ident()}")
+    try:
+        os.makedirs(os.path.dirname(probe), exist_ok=True)
+        with open(probe, "w") as f:
+            f.write("ok")
+        os.remove(probe)
+        return spill_dir
+    except OSError as e:
+        with _DEGRADED_LOCK:
+            first = spill_dir not in _DEGRADED_DIRS
+            _DEGRADED_DIRS.add(spill_dir)
+        if first:
+            logger.warning(
+                "spill dir %r is not writable (%r); DEGRADING to the "
+                "host-resident path — host RSS is no longer bounded by "
+                "the chunk window for this build", spill_dir, e)
+            telemetry.count("reliability.degraded")
+        return None
 
 
 def store_key(rows, labels: np.ndarray, weights: np.ndarray, dim: int,
@@ -442,7 +525,29 @@ class ChunkStore:
         from photon_ml_tpu.cache.plan_cache import atomic_savez
 
         meta, arrays = self._encode(chunk)
-        atomic_savez(self.path(i), meta, arrays)
+        path = self.path(i)
+
+        def _write():
+            # The fault seam sits INSIDE the attempt so a transient
+            # injected write error exercises the same retry the real
+            # failure would.
+            _faults.fire("store.spill", path=path, chunk=i)
+            atomic_savez(path, meta, arrays)
+
+        try:
+            _retry.run_with_retries(_write, f"chunk spill {path}")
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                # Capacity, not transience: ONE actionable error with
+                # the numbers the operator needs (satellite — the raw
+                # ENOSPC used to propagate from the prefetch thread).
+                telemetry.count("reliability.actionable_errors")
+                raise ChunkStoreSpillError(
+                    os.path.dirname(self.dir) or self.dir,
+                    sum(int(np.asarray(a).nbytes)
+                        for a in arrays.values()),
+                    _free_bytes(self.dir)) from e
+            raise
         with self._lock:
             # ``put`` runs on the build thread AND (rebuild re-spill)
             # the prefetch thread — the counter is shared state.
@@ -451,7 +556,7 @@ class ChunkStore:
         try:
             telemetry.count("store.bytes_spilled",
                             os.path.getsize(self.path(i)))
-        except OSError:      # racing cleanup: the metric is best-effort
+        except OSError:  # photon-lint: disable=swallowed-exception (racing cleanup; the size metric is best-effort)
             pass
         if keep_resident is None:
             keep_resident = i < self.host_max_resident
@@ -479,7 +584,11 @@ class ChunkStore:
             self.access_log.append(i)
             self.loads += 1
         telemetry.count("store.loads")
-        try:
+
+        def _attempt():
+            # Fault seam per ATTEMPT (a transient injected read error
+            # exercises the same bounded retry a flaky disk would).
+            _faults.fire("store.load", path=path, chunk=i)
             try:
                 arrays = _open_npz_mmap(path)
                 telemetry.count("store.mmap_loads")
@@ -491,11 +600,19 @@ class ChunkStore:
             try:
                 telemetry.count("store.bytes_read",
                                 os.path.getsize(path))
-            except OSError:
+            except OSError:  # photon-lint: disable=swallowed-exception (best-effort size metric; racing cleanup)
                 pass
             meta = json.loads(bytes(np.asarray(arrays["__meta__"]))
                               .decode())
             return self._decode(meta, arrays)
+
+        try:
+            # Transient read errors (EIO and friends) retry with
+            # bounded backoff before the lineage rebuild; corruption
+            # (ValueError / BadZipFile) and ENOENT go straight to
+            # rebuild — retrying cannot change file content.
+            return _retry.run_with_retries(
+                _attempt, f"chunk load {path}")
         except Exception as e:
             if self._rebuild is None:
                 raise

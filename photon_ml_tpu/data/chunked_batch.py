@@ -291,6 +291,14 @@ def build_chunked_batch(
             )
         return group(make_pieces(pieces_arr, grr_pairs, zero_offsets))
 
+    if spill_dir is not None:
+        # Unwritable spill dir DEGRADES to the resident build with one
+        # warning (ISSUE 9): losing the disk tier costs memory bound,
+        # not the run.
+        from photon_ml_tpu.data.chunk_store import probe_spill_dir
+
+        spill_dir = probe_spill_dir(spill_dir)
+
     if spill_dir is None:
         # One aggregation scope around the whole sharded build: every
         # per-shard sub-plan's spill note folds into ONE summary line

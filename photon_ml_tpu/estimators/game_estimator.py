@@ -718,7 +718,8 @@ class GameEstimator:
 
     def _train_swept_lanes(self, coords, name: str, lams, offsets,
                           locked: dict, validation, run_logger,
-                          warm_W=None, base_w0=None):
+                          warm_W=None, base_w0=None, checkpointer=None,
+                          resume: bool = False, stage: str = "swept"):
         """Train λ lanes as ONE batched sweep; returns (FitResults in
         the order of ``lams``, W [L, dim] in that order).
 
@@ -726,8 +727,20 @@ class GameEstimator:
         strongly regularized lanes converge first and coast under the
         masked while_loop while weakly regularized stragglers keep
         refining); results are mapped back to the caller's order.
+
+        With a ``checkpointer`` (ISSUE 9) the lane matrix, sweep index,
+        and per-lane validation history snapshot to stage ``stage``
+        after every sweep, the swept solver checkpoints mid-solve under
+        a per-sweep scope, and ``resume`` restores — so a SIGKILL mid
+        swept fit resumes at its exact (sweep, solver iteration).
         """
         import time as _time
+
+        from photon_ml_tpu.game.coordinate_descent import (
+            _revive_validation,
+            _serialize_validation,
+        )
+        from photon_ml_tpu.reliability import checkpoint as _ckpt
 
         cfg = self.config
         by_name = {c.name: c for c in cfg.coordinates}
@@ -751,6 +764,7 @@ class GameEstimator:
 
         t0 = _time.perf_counter()
         res = None
+        res_summary: dict | None = None
         inv_idx = jnp.asarray(inv)
         # Per-sweep validation mirrors _fit_point's validator (the
         # reference scores validation data every CD iteration): one
@@ -758,29 +772,71 @@ class GameEstimator:
         # transforms the sequential grid pays.
         validate = (validation is not None and cfg.validate_per_iteration)
         lane_history: list[list] = [[] for _ in range(L)]
-        for _ in range(cfg.n_iterations):
-            with telemetry.span("swept_train", cat="train",
-                                coordinate=name, lanes=L):
-                W, res = coord.train_swept(offsets, reg, warm_start=W)
-            if validate:
-                with telemetry.span("swept_validation", cat="train",
-                                    coordinate=name, lanes=L):
-                    W_now = W[inv_idx]
-                    for j in range(L):
-                        snap = self._swept_lane_model(
-                            coords, name, W_now[j], locked, offsets,
-                            float(lams[j]), with_variances=False)
-                        lane_history[j].append(
-                            self._evaluate(snap, validation))
+        start_sweep = 0
+        if checkpointer is not None and resume:
+            st = checkpointer.load_stage(stage)
+            if (st is not None
+                    and [float(x) for x in st["lams"]]
+                    == [float(x) for x in lams]):
+                start_sweep = int(st["sweep"])
+                if st.get("W") is not None:
+                    W = jnp.asarray(st["W"], jnp.float32)
+                lane_history = [_revive_validation(h)
+                                for h in st.get("lane_history") or []]
+                while len(lane_history) < L:
+                    lane_history.append([])
+                res_summary = st.get("res_summary")
+                logger.info("swept fit '%s': resumed at sweep %d/%d",
+                            name, start_sweep, cfg.n_iterations)
+        with _ckpt.session(checkpointer):
+            for i in range(start_sweep, cfg.n_iterations):
+                scope = (checkpointer.scope(f"{stage}_s{i + 1}")
+                         if checkpointer is not None
+                         else contextlib.nullcontext())
+                with scope, telemetry.span("swept_train", cat="train",
+                                           coordinate=name, lanes=L):
+                    W, res = coord.train_swept(offsets, reg, warm_start=W)
+                if validate:
+                    with telemetry.span("swept_validation", cat="train",
+                                        coordinate=name, lanes=L):
+                        W_now = W[inv_idx]
+                        for j in range(L):
+                            snap = self._swept_lane_model(
+                                coords, name, W_now[j], locked, offsets,
+                                float(lams[j]), with_variances=False)
+                            lane_history[j].append(
+                                self._evaluate(snap, validation))
+                # Sweep-boundary lane snapshots honor the same
+                # ``checkpoint_every_sweeps`` cadence as maybe_save_cd —
+                # the [L, dim] lane matrix is the expensive part of the
+                # payload, and the final sweep always saves.
+                if checkpointer is not None and (
+                        (i + 1) == cfg.n_iterations
+                        or (i + 1) % checkpointer.every_sweeps == 0):
+                    res_summary = {
+                        "lanes_converged": int(jnp.sum(res.converged)),
+                        "max_solver_iterations": int(
+                            jnp.max(res.iterations))}
+                    checkpointer.save_stage(stage, {
+                        "lams": [float(x) for x in lams],
+                        "sweep": i + 1,
+                        "W": W,   # internal λ-descending lane order
+                        "lane_history": [
+                            _serialize_validation(h)
+                            for h in lane_history],
+                        "res_summary": res_summary,
+                    })
         elapsed = _time.perf_counter() - t0
         logger.info("swept fit: %d λ-lanes of '%s' in %.2fs", L, name,
                     elapsed)
+        if res is not None:
+            res_summary = {
+                "lanes_converged": int(jnp.sum(res.converged)),
+                "max_solver_iterations": int(jnp.max(res.iterations))}
         if run_logger is not None:
             run_logger.event(
                 "swept_fit", coordinate=name, lanes=L,
-                duration_s=round(elapsed, 4),
-                lanes_converged=int(jnp.sum(res.converged)),
-                max_solver_iterations=int(jnp.max(res.iterations)),
+                duration_s=round(elapsed, 4), **(res_summary or {}),
             )
         W_out = W[inv_idx]
         results = []
@@ -842,10 +898,26 @@ class GameEstimator:
                     len(lams))
         results, _ = self._train_swept_lanes(
             coords, name, lams, offsets, locked, validation, run_logger,
-            base_w0=base_w0)
+            base_w0=base_w0,
+            checkpointer=self._checkpointer(self.config.checkpoint_dir,
+                                            run_logger),
+            resume=self.config.resume)
         return results
 
     # -- fit ---------------------------------------------------------------
+
+    def _checkpointer(self, ckpt_dir: str | None, run_logger):
+        """Config-cadenced ``reliability.checkpoint.RunCheckpointer``
+        for ``ckpt_dir`` (None when checkpointing is off)."""
+        if not ckpt_dir:
+            return None
+        from photon_ml_tpu.reliability.checkpoint import RunCheckpointer
+
+        cfg = self.config
+        return RunCheckpointer(
+            ckpt_dir, every_sweeps=cfg.checkpoint_every_sweeps,
+            every_solver_iters=cfg.checkpoint_every_solver_iters,
+            run_logger=run_logger, resume=cfg.resume)
 
     def _grid_points(self) -> list[dict]:
         grid = self.config.reg_weight_grid
@@ -872,8 +944,14 @@ class GameEstimator:
 
     def _fit_point(self, train: GameDataset, prep: dict, reg_weights: dict,
                    validation: GameDataset | None, run_logger,
-                   ckpt_tag: str | None = None) -> FitResult:
-        """One full coordinate-descent fit at fixed λ per coordinate."""
+                   ckpt_tag: str | None = None,
+                   checkpointing: bool = True) -> FitResult:
+        """One full coordinate-descent fit at fixed λ per coordinate.
+
+        ``checkpointing=False`` runs the point without checkpoint/
+        resume machinery even when the config carries a checkpoint_dir
+        — the non-swept tuned path, where per-trial fits sharing one
+        directory would overwrite (and cross-resume) each other."""
         cfg = self.config
         coords = self._build_coordinates(train, prep, reg_weights)
         logger.info("fit: point %s", reg_weights or "(default)")
@@ -888,9 +966,10 @@ class GameEstimator:
                 "the warm-start model")
         initial = {n: w for n, w in warm.items() if n not in locked}
 
-        ckpt_dir = cfg.checkpoint_dir
+        ckpt_dir = cfg.checkpoint_dir if checkpointing else None
         if ckpt_dir and ckpt_tag:
             ckpt_dir = f"{ckpt_dir}/{ckpt_tag}"
+        checkpointer = self._checkpointer(ckpt_dir, run_logger)
         validator = None
         if validation is not None and cfg.validate_per_iteration:
             # The reference's CoordinateDescent scores validation data
@@ -909,8 +988,9 @@ class GameEstimator:
             locked_coordinates=locked,
             initial_coefficients=initial,
             checkpoint_dir=ckpt_dir,
-            resume=cfg.resume,
+            resume=cfg.resume and checkpointing,
             run_logger=run_logger,
+            checkpointer=checkpointer,
         )
         model = self._to_game_model(coords, cd)
         if cd.validation_history:
@@ -965,8 +1045,10 @@ class GameEstimator:
             grid_points = self._grid_points()
             name = self._swept_coordinate_name()
             if (len(grid_points) > 1 and name is not None
-                    and set(self.config.reg_weight_grid) == {name}
-                    and not self.config.checkpoint_dir):
+                    and set(self.config.reg_weight_grid) == {name}):
+                # Checkpointing no longer forces the sequential path
+                # (ISSUE 9): the swept fit snapshots its lane state per
+                # sweep and its solver state per iteration.
                 return self._fit_grid_swept(train, prep, name,
                                             grid_points, validation,
                                             run_logger)
@@ -1032,10 +1114,18 @@ class GameEstimator:
             return self._fit_tuned_swept(train, prep, swept_name, tuner,
                                          validation, run_logger, ev)
 
+        if cfg.checkpoint_dir:
+            # Documented limit: tuner checkpointing rides the swept
+            # batched evaluator (round-granular lane state); per-point
+            # tuned fits run without checkpoints rather than dying.
+            logger.warning(
+                "checkpoint_dir is set but this tuning shape is not "
+                "swept-eligible; running WITHOUT tuner checkpoints")
+
         def evaluate_fn(point: dict):
             result = self._fit_point(
                 train, prep, dict(point), validation, run_logger,
-                ckpt_tag=None)
+                ckpt_tag=None, checkpointing=False)
             return result.evaluations[ev], result
 
         trials = tuner.run(evaluate_fn, tuning.n_trials,
@@ -1053,11 +1143,57 @@ class GameEstimator:
         Warm-start continuation across rounds: each new lane starts
         from the previous round's nearest-log-λ solution (lanes
         ordered λ-descending inside each solve)."""
-        tuning = self.config.tuning
+        from photon_ml_tpu.game.coordinate_descent import (
+            _revive_validation,
+            _serialize_validation,
+        )
+
+        cfg = self.config
+        tuning = cfg.tuning
         hi = float(tuning.reg_weight_ranges[name]["high"])
         coords, locked, offsets, base_w0 = self._swept_setup(
             train, prep, name, hi)
         prev: dict = {"lams": None, "W": None}
+        ck = self._checkpointer(cfg.checkpoint_dir, run_logger)
+        rounds: list = []
+        restored: list = []
+        if ck is not None and cfg.resume:
+            # One stage file PER round (``tuner_hist_<r>``): each round
+            # writes only its own lane matrix — a cumulative snapshot
+            # would re-serialize every prior round's [L, d] matrix each
+            # round (O(R²) checkpoint I/O over the search).
+            while True:
+                st = ck.load_stage(f"tuner_hist_{len(rounds)}")
+                if st is None:
+                    break
+                rounds.append(st)
+            # Restored tuner history (ISSUE 9): completed rounds feed
+            # the search as observations, and their FitResults
+            # materialize straight from the checkpointed lane matrix —
+            # model export + saved metrics, NO re-training.
+            for r in rounds:
+                W_r = jnp.asarray(r["W"], jnp.float32)
+                hists = r.get("histories") or []
+                for j, lam in enumerate(r["lams"]):
+                    lam = float(lam)
+                    model = self._swept_lane_model(
+                        coords, name, W_r[j], locked, offsets, lam)
+                    evals = _revive_validation([r["evals"][j]])[0]
+                    fr = FitResult(
+                        model=model, evaluations=evals,
+                        reg_weights={c.name: (lam if c.name == name
+                                              else c.optimizer.reg_weight)
+                                     for c in cfg.coordinates},
+                        validation_history=_revive_validation(
+                            hists[j] if j < len(hists) else []))
+                    restored.append(({name: lam},
+                                     float(r["values"][j]), fr))
+                prev["lams"] = [float(x) for x in r["lams"]]
+                prev["W"] = W_r
+            if rounds:
+                logger.info("tuned fit: restored %d trials from %d "
+                            "checkpointed rounds", len(restored),
+                            len(rounds))
 
         def evaluate_batch(configs: list[dict]):
             lams = [float(c[name]) for c in configs]
@@ -1071,13 +1207,32 @@ class GameEstimator:
                 warm_W = jnp.stack([prev["W"][i] for i in idx])
             results, W_out = self._train_swept_lanes(
                 coords, name, lams, offsets, locked, validation,
-                run_logger, warm_W=warm_W, base_w0=base_w0)
+                run_logger, warm_W=warm_W, base_w0=base_w0,
+                checkpointer=ck, resume=cfg.resume,
+                stage=f"tuner_round_{len(rounds)}")
             prev["lams"], prev["W"] = lams, W_out
+            if ck is not None:
+                rd = {
+                    "lams": lams,
+                    "values": [float(r.evaluations[ev])
+                               for r in results],
+                    "W": W_out,
+                    "evals": _serialize_validation(
+                        [r.evaluations for r in results]),
+                    # Per-sweep validation trace per trial, so a
+                    # restored round's FitResults keep the
+                    # validation_history an uninterrupted run carries.
+                    "histories": [_serialize_validation(
+                        r.validation_history) for r in results],
+                }
+                rounds.append(rd)
+                ck.save_stage(f"tuner_hist_{len(rounds) - 1}", rd)
             return [(r.evaluations[ev], r) for r in results]
 
         trials = tuner.run_batched(
             evaluate_batch, tuning.n_trials,
-            batch_size=tuning.trial_batch, run_logger=run_logger)
+            batch_size=tuning.trial_batch, run_logger=run_logger,
+            restored=restored)
         return [t.payload for t in trials]
 
     def best(self, results: list[FitResult]) -> FitResult:

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.reliability import faults as _faults
 from photon_ml_tpu.data.sparse_rows import SparseRows
 from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.models.game import (
@@ -168,6 +169,7 @@ class _SinkWriter:
                 t0 = time.perf_counter()
                 with telemetry.span("sink_write", cat="sink",
                                     lo=lo, hi=hi):
+                    _faults.fire("sink.write", lo=lo, hi=hi)
                     for s in self._sinks:
                         s.write(lo, hi, margins, preds, labels, ids=ids)
                 telemetry.observe("sink.write_s",
@@ -178,6 +180,17 @@ class _SinkWriter:
                 telemetry.thread_exception("sink-writer", e)
                 with self._lock:
                     self._error = e
+                # A failed writer must never leave a torn container on
+                # disk, no matter what the producer does next (ISSUE 9
+                # satellite): abort every sink HERE, at the chunk
+                # boundary the failure landed on.  abort() is
+                # idempotent, so the producer's own cleanup racing this
+                # is harmless.
+                for s in self._sinks:
+                    try:
+                        s.abort()
+                    except BaseException:  # photon-lint: disable=swallowed-exception (cleanup of an already-failed sink; the primary error is already recorded above)
+                        pass
 
     def put(self, lo, hi, margins, preds, labels, ids) -> None:
         err = self._failed()
@@ -436,8 +449,13 @@ class StreamingGameScorer:
             specs, tables, build_chunk, key_parts = planned
         run = self._make_program(specs)
 
+        from photon_ml_tpu.data.chunk_store import probe_spill_dir
+
         store = None
-        if self.spill_dir is not None:
+        # Unwritable spill dir degrades to build-on-the-fly chunks with
+        # one warning (ISSUE 9): the disk tier is an optimization here,
+        # never a correctness dependency.
+        if probe_spill_dir(self.spill_dir) is not None:
             store = self._make_store(n_chunks, key_parts, build_chunk)
             load = store.get
         else:
@@ -518,7 +536,7 @@ class StreamingGameScorer:
                     for out in (m, p):
                         try:
                             out.copy_to_host_async()
-                        except AttributeError:
+                        except AttributeError:  # photon-lint: disable=swallowed-exception (backends without async D2H; drain copies synchronously)
                             pass
                     pending.append((i, m, p))
                     if len(pending) > _INFLIGHT:
@@ -534,12 +552,12 @@ class StreamingGameScorer:
             if writer is not None:
                 try:
                     writer.close()
-                except BaseException:
+                except BaseException:  # photon-lint: disable=swallowed-exception (error-path cleanup; the original pass failure re-raises below)
                     pass
             for s in sinks:
                 try:
                     s.abort()
-                except BaseException:
+                except BaseException:  # photon-lint: disable=swallowed-exception (error-path cleanup; the original pass failure re-raises below)
                     pass
             raise
         wall_s = time.perf_counter() - t0

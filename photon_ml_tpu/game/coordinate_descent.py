@@ -23,6 +23,7 @@ coordinates).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import time
@@ -32,10 +33,56 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.reliability import checkpoint as _ckpt
 from photon_ml_tpu.telemetry import convergence as _conv
 from photon_ml_tpu.game.coordinates import Coordinate
 
 logger = logging.getLogger(__name__)
+
+
+def _serialize_history(history: list) -> list:
+    """Per-iteration diagnostics → checkpoint-tree form (raw
+    OptimizationResult diagnostics reduce through ``_diag_fields``;
+    already-serialized entries — a resumed run's restored prefix —
+    pass through)."""
+    out = []
+    for iter_diag in history:
+        out.append({name: (diag if isinstance(diag, dict)
+                           else _diag_fields(diag))
+                    for name, diag in iter_diag.items()})
+    return out
+
+
+def _serialize_validation(entries: list) -> list:
+    out = []
+    for e in entries:
+        if isinstance(e, dict):
+            out.append({str(getattr(k, "value", k)): float(v)
+                        for k, v in e.items()})
+        else:
+            out.append(float(e))
+    return out
+
+
+def _revive_validation(entries: list) -> list:
+    """Inverse of ``_serialize_validation``: dict keys come back as
+    ``EvaluatorType`` where they parse (downstream model selection
+    indexes evaluations by the enum), else stay strings."""
+    from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+
+    out = []
+    for e in entries or []:
+        if isinstance(e, dict):
+            revived = {}
+            for k, v in e.items():
+                try:
+                    revived[EvaluatorType(k)] = v
+                except ValueError:
+                    revived[k] = v
+            out.append(revived)
+        else:
+            out.append(e)
+    return out
 
 
 @jax.jit
@@ -125,7 +172,10 @@ class CoordinateDescentResult:
     coefficients: dict          # name → coordinate-specific coefficients
     scores: dict                # name → final per-example scores [n]
     total_scores: jnp.ndarray   # [n]
-    history: list               # per iteration: {coordinate: diagnostics}
+    history: list               # per iteration: {coordinate: scalar
+                                # diagnostic fields (plain dict — the
+                                # checkpoint-serializable form, uniform
+                                # across fresh and resumed runs)}
     validation_history: list    # per iteration: metric value (if validator)
 
 
@@ -139,6 +189,7 @@ def run_coordinate_descent(
     checkpoint_dir: str | None = None,
     resume: bool = False,
     run_logger=None,
+    checkpointer=None,
 ) -> CoordinateDescentResult:
     """Run GAME coordinate descent.
 
@@ -163,12 +214,20 @@ def run_coordinate_descent(
       initial_coefficients: name → starting coefficients (warm start
         from a previous model, reference ``modelInputDir`` semantics):
         the coordinate starts scored at these values instead of zero.
-      checkpoint_dir: if set, save (coefficients, iteration) after every
-        completed sweep (see ``photon_ml_tpu.utils.checkpoint``).
-      resume: resume from the latest checkpoint in ``checkpoint_dir``
-        (overrides ``initial_coefficients`` for checkpointed names).
+      checkpoint_dir: if set, snapshot run state after every completed
+        sweep via ``reliability.checkpoint.RunCheckpointer`` (format is
+        a superset of the legacy ``utils.checkpoint`` files).
+      resume: resume from the most advanced checkpoint in
+        ``checkpoint_dir`` (overrides ``initial_coefficients`` for
+        checkpointed names; restores mid-sweep position and streamed-RE
+        retirement state when present).
       run_logger: optional ``photon_ml_tpu.utils.run_log.RunLogger`` for
         structured per-iteration events.
+      checkpointer: pre-configured ``RunCheckpointer`` (cadence knobs
+        from ``TrainingConfig``); built from ``checkpoint_dir`` with
+        defaults when omitted.  While the loop runs it is also the
+        ACTIVE checkpoint session, so the streaming solvers snapshot
+        mid-solve state under the loop's (iteration, coordinate) scope.
     """
     locked_coordinates = locked_coordinates or {}
     initial_coefficients = dict(initial_coefficients or {})
@@ -177,19 +236,41 @@ def run_coordinate_descent(
             raise ValueError(f"coordinate '{name}' has no trainable unit "
                              "and is not locked")
 
+    if checkpointer is None and checkpoint_dir:
+        checkpointer = _ckpt.RunCheckpointer(checkpoint_dir,
+                                             run_logger=run_logger,
+                                             resume=resume)
     start_iteration = 0
+    start_pos = 0
     ckpt_scores: dict = {}
+    restored_extra: dict = {}
     if resume:
-        if not checkpoint_dir:
+        if checkpointer is None:
             raise ValueError("resume=True requires checkpoint_dir")
-        from photon_ml_tpu.utils.checkpoint import load_latest_checkpoint
-
-        loaded = load_latest_checkpoint(checkpoint_dir)
+        loaded = checkpointer.load_latest_cd()
         if loaded is not None:
-            start_iteration, ckpt_coefs, ckpt_scores = loaded
-            initial_coefficients.update(ckpt_coefs)
+            start_iteration = loaded["iteration"]
+            start_pos = loaded["coord_pos"]
+            initial_coefficients.update(loaded["coefs"])
+            ckpt_scores = {k: jnp.asarray(v)
+                           for k, v in loaded["scores"].items()}
+            restored_extra = loaded["extra"]
+            # Streamed-RE runtime state (retirement masks, solved
+            # offsets, resident coefficient blocks): the coordinate's
+            # canonical blocks become the warm start, so its own
+            # warm-start identity check sees ITS arrays and keeps the
+            # restored retirement bookkeeping intact.
+            for name, st in (loaded["re_state"] or {}).items():
+                coord = coordinates.get(name)
+                if coord is not None and hasattr(coord,
+                                                 "restore_runtime_state"):
+                    blocks, cached_scores = coord.restore_runtime_state(st)
+                    initial_coefficients[name] = blocks
+                    if name not in ckpt_scores:
+                        ckpt_scores[name] = cached_scores
             if run_logger is not None:
-                run_logger.event("cd_resume", iteration=start_iteration)
+                run_logger.event("cd_resume", iteration=start_iteration,
+                                 coord_pos=start_pos)
 
     coefs: dict = {}
     scores: dict = {}
@@ -224,99 +305,67 @@ def run_coordinate_descent(
         for s in scores.values():
             total = s if total is None else total + s
 
-    history, validation_history = [], []
+    history = _serialize_history(restored_extra.get("history") or [])
+    validation_history = _revive_validation(
+        restored_extra.get("validation_history"))
     # Per-coordinate objective trajectory across sweeps (ISSUE 8): the
     # delta between consecutive sweeps' final objective values is the
     # CD-level convergence signal the reference logs per iteration.
-    prev_values: dict = {}
-    for it in range(start_iteration, n_iterations):
-        iter_diag = {}
-        for name in update_sequence:
-            if name in locked_coordinates:
-                continue
-            coord = coordinates[name]
-            t0 = time.perf_counter()
-            # Per-coordinate stage span (ISSUE 7): one CD sweep's
-            # train+score for this coordinate is one block on the
-            # timeline, the unit the report's stage table attributes
-            # time to.
-            with telemetry.span("cd_coordinate", cat="cd",
-                                coordinate=name, iteration=it + 1):
-                offsets = total - scores[name]
-                # The warm-start buffer is rebound to the result right
-                # below, so let XLA write the new coefficients into the
-                # old buffer (donation; SURVEY §5.2).  NOTE: on the
-                # first sweep this consumes the caller's
-                # initial_coefficients / checkpoint-restored arrays —
-                # any later read of those buffers would hit a
-                # deleted-buffer error; nothing in this loop re-reads
-                # them (coefs[name] is rebound below).
-                w, diag = coord.train(offsets, coefs.get(name),
-                                      donate_warm_start=True)
-                new_scores = coord.score(w)
-            # ``offsets`` already holds total − old scores; reusing it
-            # saves one [n]-vector op per coordinate per sweep (and
-            # matches the reference's residual algebra exactly).
-            total = offsets + new_scores
-            scores[name] = new_scores
-            coefs[name] = w
-            iter_diag[name] = diag
-            elapsed = time.perf_counter() - t0
-            # Retirement hook (streamed random effects, ISSUE 5): the
-            # coordinate stashed this sweep's converged-entity
-            # candidates during train; committing them HERE — after the
-            # scores are folded into the totals — freezes their
-            # coefficients so the next sweep re-packs only the active
-            # entities into chunks.  Part of the Coordinate contract:
-            # the base returns None (no retirement protocol).
-            newly_retired = coord.retire_converged()
-            if newly_retired:
-                telemetry.count("cd.entities_retired", newly_retired)
-            extra = ({} if newly_retired is None
-                     else {"entities_newly_retired": newly_retired})
-            telemetry.count("cd.coordinate_updates")
-            # Objective delta vs this coordinate's previous sweep, and
-            # a convergence trace for resident solves (streaming
-            # coordinates emit their own — traces_convergence).
-            if hasattr(diag, "value") and jnp.ndim(diag.value) == 0:
-                value = float(diag.value)
-                if name in prev_values:
-                    delta = prev_values[name] - value
-                    extra["value_delta"] = round(delta, 8)
-                    telemetry.observe("cd.objective_delta", delta)
-                prev_values[name] = value
-                if not getattr(coord, "traces_convergence", False):
-                    _conv.solve_trace("resident", name, diag)
-            logger.info(
-                "CD iter %d coordinate %s trained in %.2fs",
-                it + 1, name, elapsed,
-            )
-            if run_logger is not None:
-                run_logger.event(
-                    "cd_coordinate", iteration=it + 1, coordinate=name,
-                    duration_s=round(elapsed, 4), **_diag_fields(diag),
-                    **extra,
-                )
-        history.append(iter_diag)
-        if validator is not None:
-            with telemetry.span("cd_validation", cat="cd",
-                                iteration=it + 1):
-                metric = _call_validator(validator, coefs, total)
-            validation_history.append(metric)
-            if isinstance(metric, dict):
-                fields = {str(getattr(k, "value", k)): float(v)
-                          for k, v in metric.items()}
-            else:
-                fields = {"metric": float(metric)}
-            logger.info("CD iter %d validation %s", it + 1, fields)
-            if run_logger is not None:
-                run_logger.event("cd_validation", iteration=it + 1,
-                                 **fields)
-        if checkpoint_dir is not None:
-            from photon_ml_tpu.utils.checkpoint import save_checkpoint
+    prev_values: dict = dict(restored_extra.get("prev_values") or {})
 
-            save_checkpoint(checkpoint_dir, it + 1, coefs,
-                            scores={**scores, "__cd_total__": total})
+    def _re_states() -> dict:
+        return {name: coord.runtime_state()
+                for name, coord in coordinates.items()
+                if hasattr(coord, "runtime_state")
+                and name not in locked_coordinates}
+
+    def _extra() -> dict:
+        return {"history": _serialize_history(history),
+                "validation_history": _serialize_validation(
+                    validation_history),
+                "prev_values": dict(prev_values)}
+
+    # A mid-sweep resume re-enters a PARTIAL sweep: the coordinates it
+    # skips already trained before the kill, and their diagnostics ride
+    # in the partial snapshot — seed them back so the resumed sweep's
+    # history entry matches the uninterrupted run's record.
+    partial_diag = dict(restored_extra.get("partial_iter_diag") or {})
+
+    ckpt_session = (_ckpt.session(checkpointer) if checkpointer is not None
+                    else contextlib.nullcontext())
+    with ckpt_session:
+        for it in range(start_iteration, n_iterations):
+            total, iter_diag = _run_sweep(
+                coordinates, update_sequence, locked_coordinates, coefs,
+                scores, it, start_iteration, start_pos, checkpointer,
+                run_logger, prev_values, total, _extra, _re_states,
+                seed_diag=(partial_diag if it == start_iteration
+                           else None))
+            # Normalized to the serialized (plain-dict) diagnostic form
+            # so ``CoordinateDescentResult.history`` is uniformly typed
+            # whether or not the run was resumed (the restored prefix
+            # arrives serialized from the checkpoint).
+            history.append(_serialize_history([iter_diag])[0])
+            if validator is not None:
+                with telemetry.span("cd_validation", cat="cd",
+                                    iteration=it + 1):
+                    metric = _call_validator(validator, coefs, total)
+                validation_history.append(metric)
+                if isinstance(metric, dict):
+                    fields = {str(getattr(k, "value", k)): float(v)
+                              for k, v in metric.items()}
+                else:
+                    fields = {"metric": float(metric)}
+                logger.info("CD iter %d validation %s", it + 1, fields)
+                if run_logger is not None:
+                    run_logger.event("cd_validation", iteration=it + 1,
+                                     **fields)
+            if checkpointer is not None:
+                checkpointer.maybe_save_cd(
+                    it + 1, coefs,
+                    scores={**scores, "__cd_total__": total},
+                    re_state=_re_states(), extra=_extra(),
+                    final=(it + 1 == n_iterations))
 
     return CoordinateDescentResult(
         coefficients=coefs,
@@ -325,3 +374,105 @@ def run_coordinate_descent(
         history=history,
         validation_history=validation_history,
     )
+
+
+def _run_sweep(coordinates, update_sequence, locked_coordinates, coefs,
+               scores, it, start_iteration, start_pos, checkpointer,
+               run_logger, prev_values, total, extra_fn, re_states_fn,
+               seed_diag=None):
+    """One CD sweep over the update sequence (split out so the resume
+    position logic stays readable).  Mutates ``coefs``/``scores``/
+    ``prev_values`` in place; returns (total, iteration diagnostics).
+    ``extra_fn``/``re_states_fn`` supply the parent loop's history and
+    streamed-RE state snapshots for mid-sweep partial checkpoints (one
+    collection rule for partial AND boundary snapshots); ``seed_diag``
+    pre-fills the skipped coordinates' diagnostics when re-entering a
+    partial sweep after a resume."""
+    iter_diag = dict(seed_diag or {})
+    for pos, name in enumerate(update_sequence):
+        if name in locked_coordinates:
+            continue
+        if it == start_iteration and pos < start_pos:
+            # Mid-sweep resume: this coordinate already trained in the
+            # interrupted sweep — its coefficients/scores came back
+            # with the partial snapshot.
+            continue
+        coord = coordinates[name]
+        t0 = time.perf_counter()
+        scope = (checkpointer.scope(f"it{it + 1}", name)
+                 if checkpointer is not None
+                 else contextlib.nullcontext())
+        # Per-coordinate stage span (ISSUE 7): one CD sweep's
+        # train+score for this coordinate is one block on the
+        # timeline, the unit the report's stage table attributes
+        # time to.
+        with scope, telemetry.span("cd_coordinate", cat="cd",
+                                   coordinate=name, iteration=it + 1):
+            offsets = total - scores[name]
+            # The warm-start buffer is rebound to the result right
+            # below, so let XLA write the new coefficients into the
+            # old buffer (donation; SURVEY §5.2).  NOTE: on the
+            # first sweep this consumes the caller's
+            # initial_coefficients / checkpoint-restored arrays —
+            # any later read of those buffers would hit a
+            # deleted-buffer error; nothing in this loop re-reads
+            # them (coefs[name] is rebound below).
+            w, diag = coord.train(offsets, coefs.get(name),
+                                  donate_warm_start=True)
+            new_scores = coord.score(w)
+        # ``offsets`` already holds total − old scores; reusing it
+        # saves one [n]-vector op per coordinate per sweep (and
+        # matches the reference's residual algebra exactly).
+        total = offsets + new_scores
+        scores[name] = new_scores
+        coefs[name] = w
+        iter_diag[name] = diag
+        elapsed = time.perf_counter() - t0
+        # Retirement hook (streamed random effects, ISSUE 5): the
+        # coordinate stashed this sweep's converged-entity
+        # candidates during train; committing them HERE — after the
+        # scores are folded into the totals — freezes their
+        # coefficients so the next sweep re-packs only the active
+        # entities into chunks.  Part of the Coordinate contract:
+        # the base returns None (no retirement protocol).
+        newly_retired = coord.retire_converged()
+        if newly_retired:
+            telemetry.count("cd.entities_retired", newly_retired)
+        extra = ({} if newly_retired is None
+                 else {"entities_newly_retired": newly_retired})
+        telemetry.count("cd.coordinate_updates")
+        # Objective delta vs this coordinate's previous sweep, and
+        # a convergence trace for resident solves (streaming
+        # coordinates emit their own — traces_convergence).
+        if hasattr(diag, "value") and jnp.ndim(diag.value) == 0:
+            value = float(diag.value)
+            if name in prev_values:
+                delta = prev_values[name] - value
+                extra["value_delta"] = round(delta, 8)
+                telemetry.observe("cd.objective_delta", delta)
+            prev_values[name] = value
+            if not getattr(coord, "traces_convergence", False):
+                _conv.solve_trace("resident", name, diag)
+        logger.info(
+            "CD iter %d coordinate %s trained in %.2fs",
+            it + 1, name, elapsed,
+        )
+        if run_logger is not None:
+            run_logger.event(
+                "cd_coordinate", iteration=it + 1, coordinate=name,
+                duration_s=round(elapsed, 4), **_diag_fields(diag),
+                **extra,
+            )
+        if checkpointer is not None and checkpointer.mid_sweep_enabled:
+            # Mid-sweep position snapshot (ISSUE 9): ``pos + 1``
+            # update-sequence entries of sweep ``it + 1`` are done, so
+            # a kill during the NEXT coordinate's solve resumes here
+            # (plus whatever mid-solve state that solve checkpointed).
+            checkpointer.save_cd_partial(
+                it, pos + 1, coefs,
+                scores={**scores, "__cd_total__": total},
+                re_state=re_states_fn(),
+                extra={**extra_fn(),
+                       "partial_iter_diag":
+                           _serialize_history([iter_diag])[0]})
+    return total, iter_diag

@@ -1080,6 +1080,58 @@ class StreamedRandomEffectCoordinate(Coordinate):
         _conv.re_sweep(self.name, diag)
         return blocks_out, diag
 
+    # -- checkpoint/resume (ISSUE 9) -----------------------------------------
+
+    def runtime_state(self) -> dict:
+        """Checkpoint tree of everything the retirement machinery
+        carries BETWEEN sweeps: resident coefficient blocks,
+        active/pending masks, the score plane, and the offset baselines
+        the wake/retire decisions compare against.  Captured by the CD
+        loop's checkpointer so a resumed run retires/wakes exactly as
+        the uninterrupted run would have."""
+        return {
+            "w_host": [np.asarray(w) for w in self._w_host],
+            "active": [np.asarray(a) for a in self._active],
+            "pending": [np.asarray(p) for p in self._pending],
+            "scores_host": np.asarray(self._scores_host),
+            "solved_offsets": (None if self._solved_offsets is None
+                               else np.asarray(self._solved_offsets)),
+            "prev_offsets": (None if self._prev_offsets is None
+                             else np.asarray(self._prev_offsets)),
+        }
+
+    def restore_runtime_state(self, state: dict):
+        """Inverse of ``runtime_state``.  Returns (canonical
+        coefficient blocks, cached score plane): the CD loop installs
+        the RETURNED blocks as the warm start, so ``train``'s identity
+        check recognizes them and keeps the restored retirement
+        bookkeeping instead of resetting it (``_adopt_warm_start``
+        exists for FOREIGN warm starts, and a checkpoint is not
+        foreign)."""
+        for b, w in enumerate(state["w_host"]):
+            wb = np.asarray(w, np.float32)
+            if wb.shape != self._w_host[b].shape:
+                raise ValueError(
+                    f"checkpoint bucket {b} shape {wb.shape} != "
+                    f"{self._w_host[b].shape} (grouping changed; a "
+                    "checkpoint only resumes its own dataset/config)")
+            self._w_host[b] = wb.copy()
+            self._active[b] = np.asarray(state["active"][b], bool).copy()
+            self._pending[b] = np.asarray(state["pending"][b],
+                                          bool).copy()
+        self._scores_host = np.asarray(state["scores_host"],
+                                       np.float32).copy()
+        self._solved_offsets = (
+            None if state.get("solved_offsets") is None
+            else np.asarray(state["solved_offsets"], np.float32).copy())
+        self._prev_offsets = (
+            None if state.get("prev_offsets") is None
+            else np.asarray(state["prev_offsets"], np.float32).copy())
+        blocks = [jnp.asarray(w) for w in self._w_host]
+        self._last_w_blocks = list(blocks)
+        self._cached_scores = jnp.asarray(self._scores_host)
+        return blocks, self._cached_scores
+
     def retire_converged(self) -> int:
         """Commit this sweep's retirement candidates (the coordinate-
         descent hook, called between sweeps).  Returns the number of

@@ -71,7 +71,7 @@ class HyperparameterTuner:
 
     def run_batched(self, evaluate_batch_fn, n_trials: int,
                     batch_size: int | None = None,
-                    run_logger=None) -> list[TrialResult]:
+                    run_logger=None, restored=()) -> list[TrialResult]:
         """Drive trials in proposal BATCHES: each round proposes q
         configs (one GP fit / spread-EI pick for Bayesian, plain draws
         for random — ``propose_batch``) and hands the whole list to
@@ -80,9 +80,42 @@ class HyperparameterTuner:
         round as one fit.  ``batch_size`` None uses the strategy's
         ``default_batch`` (random: 16 — bounded, since swept solver
         state scales with lane count; GP: small rounds so later
-        proposals condition on earlier observations)."""
+        proposals condition on earlier observations).
+
+        ``restored``: ``(config, metric, payload)`` triples from a
+        checkpoint (ISSUE 9) — seeded into the observation history and
+        the returned trials, so a resumed search proposes EXACTLY the
+        rounds the interrupted run would have, without re-evaluating
+        the completed ones."""
         history: list = []
         trials: list[TrialResult] = []
+        for config, metric, payload in restored:
+            history.append((config, metric))
+            trials.append(TrialResult(config=dict(config),
+                                      metric=float(metric),
+                                      payload=payload))
+        if trials and run_logger is not None:
+            run_logger.event("tuning_restored", trials=len(trials))
+        # Replay the restored rounds' PROPOSALS (discarding the
+        # configs): the strategies draw from stateful RNGs that restart
+        # at the seed in a new process, so without the replay a resumed
+        # random search re-proposes round 0's configs instead of
+        # continuing the stream.  Each replayed round proposes against
+        # the history prefix it originally saw, which reproduces the
+        # interrupted run's draws exactly (proposals are deterministic
+        # given seed + history) and leaves every RNG where it left off.
+        pos = 0
+        while pos < len(trials) and pos < n_trials:
+            q = batch_size or getattr(self.search, "default_batch",
+                                      None) or (n_trials - pos)
+            q = min(q, n_trials - pos)
+            replayed = self.search.propose_batch(history[:pos], q)
+            for cfg, t in zip(replayed, trials[pos:pos + q]):
+                if cfg != t.config and run_logger is not None:
+                    run_logger.event("tuning_replay_divergence",
+                                     trial=pos, proposed=cfg,
+                                     restored=t.config)
+            pos += q
         while len(trials) < n_trials:
             q = batch_size or getattr(self.search, "default_batch",
                                       None) or (n_trials - len(trials))

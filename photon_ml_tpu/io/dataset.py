@@ -53,7 +53,7 @@ def _is_avro(path: str) -> bool:
     try:
         with open(path, "rb") as f:
             return f.read(4) == b"Obj\x01"
-    except OSError:
+    except OSError:  # photon-lint: disable=swallowed-exception (unreadable file is simply not detected as Avro; the real read errors loudly)
         return False
 
 

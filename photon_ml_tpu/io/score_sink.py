@@ -49,6 +49,7 @@ class NpzScoreSink:
         self.n = int(n)
         self._tmp = {}
         self._mm = {}
+        self._failed = False
         for name in self._MEMBERS:
             tmp = path + f".{name}.tmp.npy"
             self._mm[name] = np.lib.format.open_memmap(
@@ -59,18 +60,27 @@ class NpzScoreSink:
     def write(self, lo: int, hi: int, margins, predictions,
               labels, ids: dict | None = None) -> None:
         del ids   # the npz contract carries no entity-id columns
-        self._mm["scores"][lo:hi] = np.asarray(margins, np.float32)
-        self._mm["predictions"][lo:hi] = np.asarray(predictions,
-                                                    np.float32)
-        self._mm["labels"][lo:hi] = np.asarray(labels, np.float32)
+        try:
+            self._mm["scores"][lo:hi] = np.asarray(margins, np.float32)
+            self._mm["predictions"][lo:hi] = np.asarray(predictions,
+                                                        np.float32)
+            self._mm["labels"][lo:hi] = np.asarray(labels, np.float32)
+        except BaseException:
+            # A failed chunk write (shape mismatch, I/O error on a
+            # member) poisons the sink: close() must refuse to
+            # assemble the zip instead of publishing rows this chunk
+            # never landed (ISSUE 9 satellite — no torn container).
+            self._failed = True
+            raise
         self._written += hi - lo
         telemetry.count("sink.rows_written", hi - lo)
 
     def close(self) -> None:
-        if self._written != self.n:
+        if self._failed or self._written != self.n:
             self._cleanup()
             raise ValueError(
-                f"npz sink: {self._written} of {self.n} rows written")
+                f"npz sink: {self._written} of {self.n} rows written"
+                + (" (a chunk write failed)" if self._failed else ""))
         for mm in self._mm.values():
             mm.flush()
         self._mm.clear()
@@ -83,7 +93,7 @@ class NpzScoreSink:
         finally:
             try:
                 os.remove(tmp_zip)
-            except OSError:
+            except OSError:  # photon-lint: disable=swallowed-exception (tmp already os.replace'd or never created)
                 pass
             self._cleanup()
 
@@ -92,7 +102,7 @@ class NpzScoreSink:
         for tmp in self._tmp.values():
             try:
                 os.remove(tmp)
-            except OSError:
+            except OSError:  # photon-lint: disable=swallowed-exception (idempotent cleanup; member tmp may already be gone)
                 pass
 
     def abort(self) -> None:
@@ -164,6 +174,7 @@ class AvroScoreSink:
         self._f.write(self._sync)
         self.records_written = 0
         self.blocks_written = 0
+        self._failed = False
 
     def write(self, lo: int, hi: int, margins, predictions,
               labels, ids: dict | None = None) -> None:
@@ -182,10 +193,23 @@ class AvroScoreSink:
         if self.codec == "deflate":
             c = self._zlib.compressobj(wbits=-15)
             payload = c.compress(payload) + c.flush()
-        write_long(self._f, count)
-        write_long(self._f, len(payload))
-        self._f.write(payload)
-        self._f.write(self._sync)
+        block_start = self._f.tell()
+        try:
+            write_long(self._f, count)
+            write_long(self._f, len(payload))
+            self._f.write(payload)
+            self._f.write(self._sync)
+        except BaseException:
+            # Torn-block rollback (ISSUE 9 satellite): truncate back to
+            # the last block boundary so the container stays valid, and
+            # poison the sink — close() refuses to publish short data.
+            self._failed = True
+            try:
+                self._f.seek(block_start)
+                self._f.truncate()
+            except (OSError, ValueError):  # photon-lint: disable=swallowed-exception (rollback is best-effort on a failing file; the sink is poisoned and close() aborts)
+                pass
+            raise
         self.records_written += count
         self.blocks_written += 1
         telemetry.count("sink.rows_written", count)
@@ -193,6 +217,12 @@ class AvroScoreSink:
         telemetry.count("sink.bytes_written", len(payload))
 
     def close(self) -> None:
+        if self._failed:
+            self.abort()
+            raise ValueError(
+                "avro sink: a block write failed upstream; the partial "
+                f"container {self._tmp!r} was removed instead of being "
+                "published short")
         self._f.close()
         os.replace(self._tmp, self.path)
 
@@ -200,5 +230,5 @@ class AvroScoreSink:
         self._f.close()
         try:
             os.remove(self._tmp)
-        except OSError:
+        except OSError:  # photon-lint: disable=swallowed-exception (idempotent abort; tmp may already be gone)
             pass

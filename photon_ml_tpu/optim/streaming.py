@@ -33,6 +33,7 @@ to ~1 (see ``ops.objective`` swept surface).
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import queue
 import threading
@@ -44,6 +45,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.reliability import checkpoint as _ckpt
+from photon_ml_tpu.reliability import faults as _faults
 from photon_ml_tpu.telemetry import convergence as _conv
 from photon_ml_tpu.telemetry import device as _device
 from photon_ml_tpu.data.chunked_batch import ChunkedBatch
@@ -70,6 +73,13 @@ logger = logging.getLogger(__name__)
 Array = jax.Array
 
 _CURVATURE_EPS = 1e-10
+
+# Consumer-side stall deadline (seconds) for the prefetch pipeline: a
+# wedged disk (or a producer thread killed without a sentinel) turns
+# into ONE actionable error after this long, never an eternal
+# ``q.get`` (ISSUE 9).  Generous by design — a healthy chunk read is
+# milliseconds, so ten minutes means the disk tier is truly gone.
+DEFAULT_STALL_TIMEOUT_S = 600.0
 
 
 def _place_chunk(chunk, mesh):
@@ -122,13 +132,17 @@ class ChunkPrefetcher:
 
     _SENTINEL = object()
 
-    def __init__(self, load, place, depth: int, store=None):
+    def __init__(self, load, place, depth: int, store=None,
+                 stall_timeout_s: float | None = None):
         self._load = load
         self._place = place
         self._store = store
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self.stall_timeout_s = (DEFAULT_STALL_TIMEOUT_S
+                                if stall_timeout_s is None
+                                else float(stall_timeout_s))
 
     def start(self, order) -> None:
         if self._store is not None:
@@ -145,7 +159,7 @@ class ChunkPrefetcher:
                 try:
                     self._q.put(item, timeout=0.05)
                     return True
-                except queue.Full:
+                except queue.Full:  # photon-lint: disable=swallowed-exception (bounded poll; the loop re-checks the stop flag each lap)
                     continue
             return False
         # Telemetry-on path: account full-queue stall time (a full
@@ -178,9 +192,11 @@ class ChunkPrefetcher:
                     return
                 with telemetry.span("prefetch_load", cat="prefetch",
                                     chunk=i):
+                    _faults.fire("prefetch.load", chunk=i)
                     host = self._load(i)             # disk -> host
                 with telemetry.span("prefetch_place", cat="prefetch",
                                     chunk=i):
+                    _faults.fire("prefetch.place", chunk=i)
                     buf = self._place(host)          # host -> device
                 if t is not None:
                     t.count("prefetch.chunks_produced")
@@ -207,28 +223,51 @@ class ChunkPrefetcher:
 
     def next(self, expect: int):
         """The next placed chunk; raises the producer's error, and
-        asserts the deterministic order.  With telemetry active the
-        blocking wait is accounted (``prefetch.consumer_wait_s`` — the
-        numerator of the overlap-efficiency derivation) and heartbeats
-        flow while starved, so a hung producer shows as a waiting-but-
-        alive consumer."""
+        asserts the deterministic order.  The wait is a BOUNDED poll,
+        never an eternal ``q.get`` (ISSUE 9): a producer thread that
+        died without delivering (killed, lost without a sentinel)
+        raises one actionable error immediately, and a wedged disk
+        read trips ``stall_timeout_s`` into an actionable timeout.
+        With telemetry active the blocking wait is accounted
+        (``prefetch.consumer_wait_s`` — the numerator of the
+        overlap-efficiency derivation) and heartbeats flow while
+        starved, so a hung producer shows as a waiting-but-alive
+        consumer."""
         t = telemetry.active()
-        if t is None:
-            i, host, buf = self._q.get()
-        else:
-            start = time.perf_counter()
-            beat = start
-            while True:
-                try:
-                    i, host, buf = self._q.get(timeout=0.05)
-                    break
-                except queue.Empty:
-                    now = time.perf_counter()
-                    if now - beat >= t.heartbeat_s:
-                        t.heartbeat("prefetch-consumer",
-                                    state="queue_empty", expect=expect,
-                                    waiting_s=round(now - start, 3))
-                        beat = now
+        start = time.perf_counter()
+        beat = start
+        while True:
+            try:
+                i, host, buf = self._q.get(timeout=0.05)
+                break
+            except queue.Empty:
+                now = time.perf_counter()
+                thread = self._thread
+                if ((thread is None or not thread.is_alive())
+                        and self._q.empty()):
+                    telemetry.count("reliability.actionable_errors")
+                    raise RuntimeError(
+                        f"prefetch producer died without delivering "
+                        f"chunk {expect} (thread gone, queue empty, no "
+                        "in-band error); see the run log's "
+                        "thread_exception / heartbeat events for the "
+                        "stage that stopped")
+                if now - start > self.stall_timeout_s:
+                    telemetry.count("prefetch.stall_timeouts")
+                    telemetry.count("reliability.actionable_errors")
+                    raise TimeoutError(
+                        f"prefetch pipeline stalled {now - start:.1f}s "
+                        f"waiting for chunk {expect} (stall_timeout_s="
+                        f"{self.stall_timeout_s:g}): the disk/staging "
+                        "tier is wedged — check spill-dir health; the "
+                        "producer thread is still alive, so its "
+                        "heartbeat events name the stuck stage")
+                if t is not None and now - beat >= t.heartbeat_s:
+                    t.heartbeat("prefetch-consumer",
+                                state="queue_empty", expect=expect,
+                                waiting_s=round(now - start, 3))
+                    beat = now
+        if t is not None:
             t.count("prefetch.consumer_wait_s",
                     time.perf_counter() - start)
             t.count("prefetch.chunks_consumed")
@@ -241,18 +280,29 @@ class ChunkPrefetcher:
         del host   # consumer now owns the device buffer
         return buf
 
-    def close(self) -> None:
-        """Quiesce: stop the producer, drain, join.  Idempotent."""
+    def close(self, join_timeout_s: float = 10.0) -> None:
+        """Quiesce: stop the producer, drain, join — with a DEADLINE.
+        A producer wedged inside a blocking ``load`` (hung disk/NFS)
+        cannot observe the stop flag, and close() runs while the stall
+        TimeoutError unwinds — an unbounded join would re-hang the run
+        the deadline just turned into an error (review finding).  The
+        thread is a daemon, so abandoning it is safe.  Idempotent."""
         t = self._thread
         if t is None:
             return
         self._stop.set()
-        while t.is_alive():
+        deadline = time.monotonic() + join_timeout_s
+        while t.is_alive() and time.monotonic() < deadline:
             try:
                 self._q.get_nowait()   # unblock a full-queue producer
             except queue.Empty:
                 t.join(timeout=0.05)
-        t.join()
+        if t.is_alive():
+            logger.warning(
+                "prefetch thread did not exit within %.1fs (blocked "
+                "in a chunk load?); abandoning daemon thread",
+                join_timeout_s)
+            telemetry.count("prefetch.abandoned_threads")
         self._thread = None
 
 
@@ -712,7 +762,7 @@ class ChunkedGLMObjective:
                     m = fn(cur)
                 try:
                     m.copy_to_host_async()
-                except AttributeError:
+                except AttributeError:  # photon-lint: disable=swallowed-exception (backends without async D2H: the device_get below copies synchronously)
                     pass
                 lo, hi = self.batch.chunk_slice(i)
                 pending.append((m, hi - lo))
@@ -742,6 +792,58 @@ class ChunkedGLMObjective:
         return self._per_example(lambda b: _jit_xdot(w, b))
 
 
+def _tracker_state(tracker) -> dict:
+    """StatesTracker → checkpoint tree (None planes pass through)."""
+    return {"values": tracker.values, "grad_norms": tracker.grad_norms,
+            "count": tracker.count, "step_sizes": tracker.step_sizes,
+            "ls_trials": tracker.ls_trials}
+
+
+def _restore_tracker(st: dict):
+    from photon_ml_tpu.optim.base import StatesTracker
+
+    opt = lambda a: None if a is None else jnp.asarray(a, jnp.float32)
+    return StatesTracker(
+        values=jnp.asarray(st["values"], jnp.float32),
+        grad_norms=jnp.asarray(st["grad_norms"], jnp.float32),
+        count=jnp.asarray(st["count"], jnp.int32),
+        step_sizes=opt(st.get("step_sizes")),
+        ls_trials=opt(st.get("ls_trials")),
+    )
+
+
+def _solver_checkpoint(solver_name: str, label: str):
+    """(checkpointer, scoped label) when an active checkpoint session
+    has mid-solve cadence enabled, else (None, None) — the solvers'
+    one hook into ``reliability.checkpoint`` (ISSUE 9)."""
+    ck = _ckpt.active()
+    if ck is None or ck.every_solver_iters <= 0:
+        return None, None
+    name = solver_name + (f":{label}" if label else "")
+    return ck, ck.solver_label(name)
+
+
+def _solver_fingerprint(m: int, *arrays) -> str:
+    """Identity stamp for a mid-solve snapshot: the warm start and l1
+    weights pin the (objective, position) lineage — a resumed process
+    reconstructs both bitwise from the CD/stage checkpoints, while an
+    edited config (new λ grid at the same lane count, changed warm
+    path) produces different bytes, so a stale snapshot is REJECTED
+    instead of silently adopted (review finding: the scope label alone
+    cannot tell two configs apart).  ``m`` guards the (s, y) buffer
+    geometry."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(int(m)).encode())
+    for a in arrays:
+        if a is None:
+            h.update(b"|none")
+        else:
+            arr = np.asarray(a, np.float32)
+            h.update(f"|{arr.shape}".encode())
+            h.update(arr.tobytes())
+    return h.hexdigest()
+
+
 def streaming_lbfgs_solve(
     value_and_grad,
     w0: Array,
@@ -766,13 +868,6 @@ def streaming_lbfgs_solve(
     w = jnp.asarray(w0, jnp.float32)
     owlqn = l1_weight is not None
     solver_name = "streaming_owlqn" if owlqn else "streaming_lbfgs"
-    # Sweep-odometer accounting (ISSUE 8): the initial fused evaluation
-    # below is the one data pass neither an ls_trial nor a recovery
-    # counter claims — one tick per solve closes the identity
-    #   solver.sweeps == streamed_solves + ls_trials
-    #                    + grad_recovery_sweeps + aux_sweeps
-    # that `telemetry report` reconciles.
-    telemetry.count("solver.streamed_solves")
     l1 = (jnp.broadcast_to(jnp.asarray(l1_weight, w.dtype), w.shape)
           if owlqn else None)
 
@@ -789,21 +884,61 @@ def streaming_lbfgs_solve(
     def pgrad(g_, w_):
         return _pseudo_gradient(g_, w_, l1) if owlqn else g_
 
-    f, g = full_value_grad(w)
-    pg = pgrad(g, w)
-    g0_norm = float(jnp.linalg.norm(pg))
-    tracker = StatesTracker.create(config.max_iters)
-    if config.track_states:
-        tracker = tracker.record(jnp.asarray(0, jnp.int32), f,
-                                 jnp.asarray(g0_norm))
-
-    s_hist: list = []   # newest first
-    y_hist: list = []
-    rho_hist: list = []
-    converged = bool(grad_converged(jnp.asarray(g0_norm),
-                                    jnp.asarray(g0_norm),
-                                    config.tolerance))
-    it = 0
+    ck, ck_label = _solver_checkpoint(solver_name, label)
+    fp = _solver_fingerprint(m, w, l1) if ck is not None else None
+    restored = ck.load_solver(ck_label) if ck is not None else None
+    if restored is not None and restored.get("fp") != fp:
+        logger.warning(
+            "streaming lbfgs '%s': solver snapshot ignored — "
+            "objective/warm-start fingerprint mismatch (config changed "
+            "since the interrupted run?)", label)
+        restored = None
+    if restored is not None:
+        # Mid-solve resume (ISSUE 9): the loop re-enters at the exact
+        # iteration boundary the snapshot captured — committed point,
+        # value, gradient, and the full (s, y, ρ) memory — so the
+        # continuation is the run the kill interrupted.  The initial
+        # fused evaluation is NOT repaid (and not counted: the resumed
+        # process never streamed it).
+        telemetry.count("solver.resumed_solves")
+        w = jnp.asarray(restored["w"], jnp.float32)
+        f = jnp.asarray(restored["f"], jnp.float32)
+        g = jnp.asarray(restored["g"], jnp.float32)
+        pg = pgrad(g, w)
+        g0_norm = float(restored["g0_norm"])
+        s_hist = [jnp.asarray(s, jnp.float32)
+                  for s in restored["s_hist"]]
+        y_hist = [jnp.asarray(y, jnp.float32)
+                  for y in restored["y_hist"]]
+        rho_hist = [float(r) for r in restored["rho_hist"]]
+        tracker = _restore_tracker(restored["tracker"])
+        converged = bool(restored["converged"])
+        it = int(restored["it"])
+        logger.info("streaming lbfgs '%s': resumed at iteration %d",
+                    label, it)
+    else:
+        # Sweep-odometer accounting (ISSUE 8): the initial fused
+        # evaluation below is the one data pass neither an ls_trial nor
+        # a recovery counter claims — one tick per solve closes the
+        # identity
+        #   solver.sweeps == streamed_solves + ls_trials
+        #                    + grad_recovery_sweeps + aux_sweeps
+        # that `telemetry report` reconciles.
+        telemetry.count("solver.streamed_solves")
+        f, g = full_value_grad(w)
+        pg = pgrad(g, w)
+        g0_norm = float(jnp.linalg.norm(pg))
+        tracker = StatesTracker.create(config.max_iters)
+        if config.track_states:
+            tracker = tracker.record(jnp.asarray(0, jnp.int32), f,
+                                     jnp.asarray(g0_norm))
+        s_hist = []   # newest first
+        y_hist = []
+        rho_hist = []
+        converged = bool(grad_converged(jnp.asarray(g0_norm),
+                                        jnp.asarray(g0_norm),
+                                        config.tolerance))
+        it = 0
     while not converged and it < config.max_iters:
         # Two-loop recursion over the (s, y) history.
         q = pg
@@ -911,7 +1046,20 @@ def streaming_lbfgs_solve(
         if ls_ok:
             w, f, g, pg = w_new, f_new, g_new, pg_new
         converged = conv or stalled
+        if ck is not None:
+            # Iteration-boundary snapshot (cadence-gated): everything
+            # the resumed loop needs to continue bit-for-bit.
+            ck.maybe_save_solver(ck_label, it, {
+                "fp": fp,
+                "w": w, "f": f, "g": g, "g0_norm": float(g0_norm),
+                "s_hist": list(s_hist), "y_hist": list(y_hist),
+                "rho_hist": [float(r) for r in rho_hist],
+                "converged": bool(converged),
+                "tracker": _tracker_state(tracker),
+            })
 
+    if ck is not None:
+        ck.clear_solver(ck_label)   # superseded by the result
     pg_f = pgrad(g, w)
     result = OptimizationResult(
         w=w,
@@ -961,9 +1109,6 @@ def streaming_lbfgs_solve_swept(
     owlqn = l1_weights is not None
     solver_name = ("streaming_owlqn_swept" if owlqn
                    else "streaming_lbfgs_swept")
-    # One tick per solve for the initial fused sweep — see the
-    # odometer identity note in streaming_lbfgs_solve.
-    telemetry.count("solver.streamed_solves")
     if owlqn:
         l1 = jnp.asarray(l1_weights, W.dtype)
         l1 = jnp.broadcast_to(l1.reshape(L, -1), (L, d))
@@ -981,26 +1126,64 @@ def streaming_lbfgs_solve_swept(
     def pgrad(G_, W_):
         return _pseudo_gradient(G_, W_, l1) if owlqn else G_
 
-    F, G = full_vg(W)
-    PG = pgrad(G, W)
-    g0_norm = jnp.linalg.norm(PG, axis=-1)                     # [L]
-    done = grad_converged(g0_norm, g0_norm, config.tolerance)  # [L]
-    converged = done
-    iters = jnp.zeros((L,), jnp.int32)
+    ck, ck_label = _solver_checkpoint(solver_name, label)
+    fp = (_solver_fingerprint(m, W, l1 if owlqn else None)
+          if ck is not None else None)
+    restored = ck.load_solver(ck_label) if ck is not None else None
+    if restored is not None and restored.get("fp") != fp:
+        logger.warning(
+            "streaming swept lbfgs '%s': solver snapshot ignored — "
+            "objective/warm-start fingerprint mismatch (λ grid or "
+            "warm path changed since the interrupted run?)", label)
+        restored = None
+    if restored is not None:
+        # Mid-solve resume of the whole masked-lane state (ISSUE 9):
+        # λ-sweep lane coefficients, per-lane (s, y, ρ) circular
+        # buffers, convergence masks, tracker planes.
+        telemetry.count("solver.resumed_solves")
+        W = jnp.asarray(restored["W"], jnp.float32)
+        F = jnp.asarray(restored["F"], jnp.float32)
+        G = jnp.asarray(restored["G"], jnp.float32)
+        g0_norm = jnp.asarray(restored["g0_norm"], jnp.float32)
+        done = jnp.asarray(restored["done"], bool)
+        converged = jnp.asarray(restored["converged"], bool)
+        iters = jnp.asarray(restored["iters"], jnp.int32)
+        S_buf = jnp.asarray(restored["S_buf"], W.dtype)
+        Y_buf = jnp.asarray(restored["Y_buf"], W.dtype)
+        Rho = jnp.asarray(restored["Rho"], W.dtype)
+        head = jnp.asarray(restored["head"], jnp.int32)
+        count = jnp.asarray(restored["count"], jnp.int32)
+        t_vals = jnp.asarray(restored["t_vals"], jnp.float32)
+        t_gn = jnp.asarray(restored["t_gn"], jnp.float32)
+        it = int(restored["it"])
+        logger.info("streaming swept lbfgs '%s': resumed at iteration "
+                    "%d (%d/%d lanes done)", label, it,
+                    int(jnp.sum(done)), L)
+    else:
+        # One tick per solve for the initial fused sweep — see the
+        # odometer identity note in streaming_lbfgs_solve.
+        telemetry.count("solver.streamed_solves")
+        F, G = full_vg(W)
+        PG = pgrad(G, W)
+        g0_norm = jnp.linalg.norm(PG, axis=-1)                    # [L]
+        done = grad_converged(g0_norm, g0_norm, config.tolerance)  # [L]
+        converged = done
+        iters = jnp.zeros((L,), jnp.int32)
 
-    S_buf = jnp.zeros((m, L, d), W.dtype)
-    Y_buf = jnp.zeros((m, L, d), W.dtype)
-    Rho = jnp.zeros((m, L), W.dtype)
-    head = jnp.zeros((L,), jnp.int32)
-    count = jnp.zeros((L,), jnp.int32)
+        S_buf = jnp.zeros((m, L, d), W.dtype)
+        Y_buf = jnp.zeros((m, L, d), W.dtype)
+        Rho = jnp.zeros((m, L), W.dtype)
+        head = jnp.zeros((L,), jnp.int32)
+        count = jnp.zeros((L,), jnp.int32)
 
-    t_vals = jnp.full((L, config.max_iters + 1), jnp.nan, jnp.float32)
-    t_gn = jnp.full((L, config.max_iters + 1), jnp.nan, jnp.float32)
-    if config.track_states:
-        t_vals = t_vals.at[:, 0].set(F)
-        t_gn = t_gn.at[:, 0].set(g0_norm)
+        t_vals = jnp.full((L, config.max_iters + 1), jnp.nan,
+                          jnp.float32)
+        t_gn = jnp.full((L, config.max_iters + 1), jnp.nan, jnp.float32)
+        if config.track_states:
+            t_vals = t_vals.at[:, 0].set(F)
+            t_gn = t_gn.at[:, 0].set(g0_norm)
 
-    it = 0
+        it = 0
     while not bool(jnp.all(done)) and it < config.max_iters:
         active = jnp.logical_not(done)
         PG = pgrad(G, W)
@@ -1116,7 +1299,18 @@ def streaming_lbfgs_solve_swept(
             "streaming swept lbfgs iter %d: %d/%d lanes done, "
             "f_best=%.6f", it, int(jnp.sum(done)), L,
             float(jnp.min(F)))
+        if ck is not None:
+            ck.maybe_save_solver(ck_label, it, {
+                "fp": fp,
+                "W": W, "F": F, "G": G, "g0_norm": g0_norm,
+                "done": done, "converged": converged, "iters": iters,
+                "S_buf": S_buf, "Y_buf": Y_buf, "Rho": Rho,
+                "head": head, "count": count,
+                "t_vals": t_vals, "t_gn": t_gn,
+            })
 
+    if ck is not None:
+        ck.clear_solver(ck_label)   # superseded by the result
     PG_f = pgrad(G, W)
     tracker = StatesTracker(
         values=t_vals, grad_norms=t_gn,

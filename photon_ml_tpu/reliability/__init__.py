@@ -1,0 +1,29 @@
+"""Reliability tier: checkpoint/resume, fault injection, bounded-retry
+I/O (ISSUE 9).
+
+ROADMAP item 1 targets a 1.5e8-example multi-host fit — hours of wall
+clock on a mesh — and until this round any SIGKILL, ENOSPC, dead
+prefetcher thread, or corrupt chunk lost the entire run; the only
+recovery machinery was the chunk store's lineage rebuild and a
+``thread_exception`` forensic event.  Snap ML's hierarchical pipeline
+and the Spark function-minimization reference (PAPERS.md) both
+presuppose the PLATFORM's re-execution/fault-tolerance layer; a
+jax_graft rebuild has to supply its own:
+
+- ``reliability.checkpoint`` — atomic, content-addressed run-state
+  snapshots (CD loop position, coefficients, streaming-solver state,
+  RE retirement sets, λ-sweep lane state, tuner history) on a
+  configurable cadence, with ``--resume`` on the training driver
+  restoring mid-fit.
+- ``reliability.faults`` — a deterministic, seeded fault plan injected
+  at the chunk-store / prefetcher / sink seams, driving the pytest
+  fault matrix: every injected fault must end in a bounded retry, a
+  documented degradation, or ONE actionable error — never a hang or a
+  torn output.
+- ``reliability.retry`` — bounded exponential-backoff retry for
+  transient I/O, with ``store.retries`` / ``store.gave_up`` telemetry
+  and heartbeat-visible waits.
+"""
+
+from photon_ml_tpu.reliability.checkpoint import RunCheckpointer  # noqa: F401
+from photon_ml_tpu.reliability.faults import Fault, FaultInjector  # noqa: F401

@@ -1,0 +1,162 @@
+"""Deterministic fault injection at the streaming tier's seams.
+
+The fault matrix (ISSUE 9) asserts that every failure the out-of-core
+pipeline can meet — corrupt chunk, deleted chunk, slow read, transient
+read error, ENOSPC on spill, prefetcher/sink thread death, device_put
+failure — ends in a bounded retry, a documented degradation, or ONE
+actionable error: never a hang, never a torn output.  That contract is
+only testable if the faults are INJECTABLE, deterministically, at the
+seams where they occur in production:
+
+- ``store.load`` — fired in ``ChunkStore._load`` per read attempt.
+- ``store.spill`` — fired in ``ChunkStore.put`` per write attempt.
+- ``prefetch.load`` / ``prefetch.place`` — fired on the prefetch
+  thread around the disk-read and device_put stages.
+- ``sink.write`` — fired on the score sink-writer thread per chunk.
+
+A ``FaultInjector`` holds a list of ``Fault`` specs, each targeting a
+site's Nth occurrence (per-site occurrence counters under one lock, so
+multi-threaded sites count deterministically given a deterministic
+visit order).  ``seeded_plan`` derives occurrence indices from an RNG
+seed — the "chaos schedule" form — while tests mostly pin exact
+occurrences.  With no injector installed the seam is a module-global
+None check: zero overhead on the production hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import errno
+import logging
+import os
+import threading
+import time
+
+from photon_ml_tpu import telemetry
+
+logger = logging.getLogger(__name__)
+
+KINDS = ("error", "io_error", "enospc", "slow", "corrupt_file",
+         "delete_file")
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected hard failure (thread-death class)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One planned fault: site × occurrence window × effect.
+
+    ``at`` is the 0-based occurrence index of ``site`` at which the
+    fault first fires; ``count`` consecutive occurrences fire (a
+    persistent fault = large count).  ``delay_s`` applies to ``slow``;
+    ``message`` rides in raised errors."""
+
+    site: str
+    kind: str
+    at: int = 0
+    count: int = 1
+    delay_s: float = 0.05
+    message: str = "injected fault"
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"fault kind {self.kind!r} not in {KINDS}")
+
+
+class FaultInjector:
+    """Executes a fault plan at ``fire`` call sites."""
+
+    def __init__(self, faults: list[Fault]):
+        self._by_site: dict[str, list[Fault]] = {}
+        for f in faults:
+            self._by_site.setdefault(f.site, []).append(f)
+        self._lock = threading.Lock()
+        self._hits: dict[str, int] = {}
+        self.fired: list[tuple[str, str, int]] = []  # (site, kind, occ)
+
+    def occurrences(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    def fire(self, site: str, path: str | None = None, **ctx) -> None:
+        faults = self._by_site.get(site)
+        with self._lock:
+            n = self._hits.get(site, 0)
+            self._hits[site] = n + 1
+        if not faults:
+            return
+        for f in faults:
+            if not f.at <= n < f.at + f.count:
+                continue
+            with self._lock:
+                self.fired.append((site, f.kind, n))
+            telemetry.count("reliability.faults_injected")
+            logger.info("fault injected: %s/%s at occurrence %d (%s)",
+                        site, f.kind, n, ctx or path or "")
+            self._apply(f, site, path)
+
+    @staticmethod
+    def _apply(f: Fault, site: str, path: str | None) -> None:
+        if f.kind == "slow":
+            time.sleep(f.delay_s)
+        elif f.kind == "error":
+            raise InjectedFault(f"{f.message} [site={site}]")
+        elif f.kind == "io_error":
+            raise OSError(errno.EIO, f"{f.message} [site={site}]", path)
+        elif f.kind == "enospc":
+            raise OSError(errno.ENOSPC,
+                          f"No space left on device ({f.message})", path)
+        elif f.kind == "corrupt_file":
+            if path and os.path.exists(path):
+                with open(path, "r+b") as fh:
+                    fh.write(b"CORRUPTED-BY-FAULT-PLAN")
+        elif f.kind == "delete_file":
+            if path and os.path.exists(path):
+                os.remove(path)
+
+
+def seeded_plan(seed: int, site_kinds: dict[str, str],
+                horizon: int = 32) -> FaultInjector:
+    """Deterministic seeded plan: one fault per (site, kind) entry at
+    an RNG-drawn occurrence in [0, horizon) — same seed, same plan,
+    everywhere."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    faults = [Fault(site=site, kind=kind,
+                    at=int(rng.integers(0, max(1, horizon))))
+              for site, kind in sorted(site_kinds.items())]
+    return FaultInjector(faults)
+
+
+# ---------------------------------------------------------------------------
+# Module-global installation (the seam contract: one None check when
+# injection is off — the production path must not pay for testability).
+# ---------------------------------------------------------------------------
+
+_INJECTOR: FaultInjector | None = None
+
+
+def fire(site: str, path: str | None = None, **ctx) -> None:
+    """The seam call.  No-op unless an injector is installed."""
+    inj = _INJECTOR
+    if inj is not None:
+        inj.fire(site, path=path, **ctx)
+
+
+def install(inj: FaultInjector | None) -> None:
+    global _INJECTOR
+    _INJECTOR = inj
+
+
+@contextlib.contextmanager
+def injected(inj: FaultInjector):
+    """Install ``inj`` for the block (tests)."""
+    install(inj)
+    try:
+        yield inj
+    finally:
+        install(None)

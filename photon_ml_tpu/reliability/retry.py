@@ -1,0 +1,81 @@
+"""Bounded exponential-backoff retry for transient I/O.
+
+Reference counterpart: Spark's task-retry policy (``spark.task
+.maxFailures``) — the platform layer that turns a flaky disk read into
+a retried task instead of a dead job.  The rebuild's equivalent is this
+ONE helper, used by the chunk store's load and spill paths: bounded
+attempts, exponential backoff, and telemetry so retries are visible
+(``store.retries`` counts every retried attempt, ``store.gave_up``
+every exhausted budget) and waits are heartbeat-visible in the run log
+(a backoff sleep must look like a deliberate wait, not a hang).
+
+Classification is deliberately narrow: only OSErrors whose errno is in
+``TRANSIENT_ERRNOS`` retry.  ENOSPC is a capacity fact (retrying
+cannot help — the caller raises one actionable error), ENOENT is a
+lineage fact (the chunk store rebuilds), corruption (ValueError /
+BadZipFile) is a content fact (rebuild).  Deterministic backoff — no
+RNG jitter — so fault-matrix runs reproduce exactly.
+"""
+
+from __future__ import annotations
+
+import errno
+import logging
+import time
+
+from photon_ml_tpu import telemetry
+
+logger = logging.getLogger(__name__)
+
+# Retry budget defaults (overridable per call site).
+IO_ATTEMPTS = 3
+IO_BASE_DELAY_S = 0.05
+IO_MAX_DELAY_S = 2.0
+
+# OSError errnos worth retrying: device/transport hiccups that a
+# bounded backoff can outlive.  Capacity (ENOSPC), permission (EACCES/
+# EROFS/EPERM), and existence (ENOENT) errors are excluded — retrying
+# cannot change them.
+TRANSIENT_ERRNOS = frozenset({
+    errno.EIO, errno.EINTR, errno.EAGAIN, errno.EBUSY, errno.ETIMEDOUT,
+    errno.ENFILE, errno.EMFILE, errno.ESTALE,
+})
+
+
+def is_transient(e: BaseException) -> bool:
+    return isinstance(e, OSError) and e.errno in TRANSIENT_ERRNOS
+
+
+def run_with_retries(fn, label: str, attempts: int = IO_ATTEMPTS,
+                     base_delay_s: float = IO_BASE_DELAY_S,
+                     max_delay_s: float = IO_MAX_DELAY_S,
+                     retriable=is_transient,
+                     retry_counter: str = "store.retries",
+                     gave_up_counter: str = "store.gave_up"):
+    """Run ``fn()`` with up to ``attempts`` tries.
+
+    Non-retriable errors propagate immediately; a retriable error on
+    the last attempt counts ``gave_up_counter`` and propagates — the
+    caller decides whether a degradation (rebuild) or an actionable
+    error follows.  Backoff doubles per attempt, capped."""
+    attempts = max(1, int(attempts))
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except BaseException as e:
+            if not retriable(e):
+                raise
+            if attempt == attempts - 1:
+                telemetry.count(gave_up_counter)
+                logger.warning("%s: giving up after %d attempts (%r)",
+                               label, attempts, e)
+                raise
+            delay = min(base_delay_s * (2.0 ** attempt), max_delay_s)
+            telemetry.count(retry_counter)
+            telemetry.heartbeat("io-retry", label=label,
+                               attempt=attempt + 1,
+                               delay_s=round(delay, 3), error=repr(e))
+            logger.warning("%s: attempt %d/%d failed (%r); retrying in "
+                           "%.3fs", label, attempt + 1, attempts, e,
+                           delay)
+            time.sleep(delay)

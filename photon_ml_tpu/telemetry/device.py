@@ -62,7 +62,7 @@ def _platform() -> str | None:
         return None
     try:
         return jax.devices()[0].platform
-    except Exception:
+    except Exception:  # photon-lint: disable=swallowed-exception (backend probe; cost capture degrades to unlabeled platform)
         return None
 
 
@@ -96,7 +96,7 @@ def program_cost(fn, args, platform: str | None = None) -> dict | None:
         out["argument_bytes"] = int(mem.argument_size_in_bytes)
         out["output_bytes"] = int(mem.output_size_in_bytes)
         out["temp_bytes"] = int(mem.temp_size_in_bytes)
-    except Exception:            # pragma: no cover - backend-specific
+    except Exception:  # pragma: no cover - backend-specific  # photon-lint: disable=swallowed-exception (memory_analysis is optional per backend; cost rows just omit it)
         pass
     platform = platform or _platform()
     peak = PLATFORM_PEAK_GBPS.get(platform or "")
@@ -149,7 +149,7 @@ def memory_snapshot() -> dict | None:
         return None
     try:
         devices = jax.local_devices()
-    except Exception:
+    except Exception:  # photon-lint: disable=swallowed-exception (no initialized backend: the memory gauge simply has no source)
         return None
     in_use = peak = 0
     have_stats = False
@@ -171,5 +171,5 @@ def memory_snapshot() -> dict | None:
                 "bytes_in_use": int(sum(int(getattr(a, "nbytes", 0))
                                         for a in live)),
                 "buffers": len(live)}
-    except Exception:            # pragma: no cover - jax-version edge
+    except Exception:  # pragma: no cover - jax-version edge  # photon-lint: disable=swallowed-exception (live_arrays census is best-effort; gauge degrades to absent)
         return None

@@ -63,6 +63,20 @@ def load_events(path: str) -> list[dict]:
     return out
 
 
+def split_segments(events: list[dict]) -> list[list[dict]]:
+    """Split a (possibly stitched) log into per-process segments at
+    ``run_header`` events.  A resumed driver run APPENDS to the
+    interrupted run's log with a fresh header (ISSUE 9), and each
+    segment's clock restarts at zero — so spans/phases/counters must
+    reconcile per segment, never across the stitch."""
+    segs: list[list[dict]] = [[]]
+    for ev in events:
+        if ev.get("event") == "run_header" and segs[-1]:
+            segs.append([])
+        segs[-1].append(ev)
+    return segs
+
+
 def _convergence(events: list[dict], counters: dict) -> dict | None:
     """Convergence reconciliation (ISSUE 8): per-solver iteration
     totals and the sweep-odometer identity.
@@ -244,13 +258,25 @@ def reconcile(events: list[dict]) -> dict:
 def report(path: str, threshold: float = 0.9, out=None) -> dict:
     """Print the report for ``path``; returns the JSON summary dict."""
     out = out or sys.stdout
-    events = load_events(path)
+    all_events = load_events(path)
+    segments = split_segments(all_events)
+    # The LAST segment is the report of record (a resumed run's own
+    # events); earlier segments are the interrupted predecessors — a
+    # torn tail there is expected, not a finding.
+    events = segments[-1]
     summary = None
     for ev in events:
         if ev.get("event") == "telemetry_summary":
             summary = ev         # last one wins (append-mode logs)
 
     w = lambda s="": print(s, file=out)
+    if len(segments) > 1:
+        resumes = sum(1 for ev in events if ev.get("event") == "cd_resume")
+        w(f"Stitched log: {len(segments)} run segments (resumed run); "
+          f"reporting the last segment"
+          + (f", which resumed from a checkpoint" if resumes else "")
+          + ".")
+        w()
     header = next((e for e in events if e.get("event") == "run_header"),
                   None)
     if header is not None:
@@ -347,10 +373,11 @@ def report(path: str, threshold: float = 0.9, out=None) -> dict:
               "phase-boundary samples)")
         w()
 
-    torn = sum(1 for ev in events if ev.get("event") == "_malformed_line")
+    torn = sum(1 for ev in all_events
+               if ev.get("event") == "_malformed_line")
     if torn:
-        w(f"NOTE: {torn} malformed line(s) skipped (torn tail — the "
-          "run likely died mid-write).")
+        w(f"NOTE: {torn} malformed line(s) skipped (torn tail — a "
+          "run segment died mid-write).")
         w()
 
     beats: dict = {}
@@ -397,6 +424,7 @@ def report(path: str, threshold: float = 0.9, out=None) -> dict:
 
     result = {
         "ok": ok,
+        "segments": len(segments),
         "run_id": (header or {}).get("run_id"),
         "convergence": conv,
         "device": device,

@@ -72,7 +72,8 @@ class RunLogger:
     """
 
     def __init__(self, path: str | None = None, mode: str = "w",
-                 run_info: dict | None = None):
+                 run_info: dict | None = None,
+                 header: bool | None = None):
         """``mode="w"`` (default) makes each run's log self-contained —
         rerunning into the same output dir must not interleave events
         from prior runs; pass ``"a"`` to accumulate deliberately.
@@ -80,9 +81,13 @@ class RunLogger:
         A schema-versioned ``run_header`` event (run id, argv, jax
         version, platform — plus caller facts via ``run_info``, e.g.
         the telemetry mode) is written as the FIRST JSONL line of every
-        fresh file; append mode skips it (the original header stands).
-        ``report``/``history`` consume it and tolerate its absence in
-        pre-existing logs."""
+        fresh file; append mode skips it by default (the original
+        header stands).  ``header`` overrides that default: a RESUMED
+        driver run appends WITH a header, so the stitched log carries
+        one ``run_header`` per process segment and ``telemetry
+        report`` can reconcile the segments separately (their clocks
+        restart at each header).  ``report``/``history`` consume it and
+        tolerate its absence in pre-existing logs."""
         self.path = path
         self._t0 = time.monotonic()
         self._f = None
@@ -94,11 +99,27 @@ class RunLogger:
         if path is not None:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._f = open(path, mode)
+            if mode == "a":
+                # A killed predecessor can leave a TORN final line with
+                # no newline; appending straight after it would fuse
+                # this run's first event into the garbage.  Terminate
+                # the tail so the stitch is line-clean (ISSUE 9).
+                torn = False
+                try:
+                    with open(path, "rb") as tail:
+                        tail.seek(0, os.SEEK_END)
+                        if tail.tell() > 0:
+                            tail.seek(-1, os.SEEK_END)
+                            torn = tail.read(1) != b"\n"
+                    if torn:
+                        self._f.write("\n")
+                except OSError:  # photon-lint: disable=swallowed-exception (tail probe is best-effort; worst case is one fused line, the pre-fix behavior)
+                    pass
             # Flush fallback: a logger abandoned without close() (the
             # pre-ISSUE-7 driver bug) still lands its buffered tail on
             # interpreter exit.  Unregistered again in close().
             atexit.register(self.close)
-            if mode == "w":
+            if header if header is not None else mode == "w":
                 self.event("run_header", **_runtime_info(),
                            **self.run_info)
 

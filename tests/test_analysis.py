@@ -681,6 +681,92 @@ def test_metric_name_session_methods_and_waiver():
 
 
 # ---------------------------------------------------------------------------
+# swallowed-exception (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+def test_swallowed_exception_flags_silent_discards():
+    vs = check_source(_src("""
+        import os
+
+        def cleanup(paths):
+            for p in paths:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+        def probe(path):
+            try:
+                return os.path.getsize(path)
+            except OSError:
+                return None
+
+        def drain(q):
+            while True:
+                try:
+                    return q.get_nowait()
+                except Exception:
+                    continue
+    """))
+    assert _rules(vs) == ["swallowed-exception"] * 3
+    assert {v.line for v in vs} == {7, 13, 20}
+
+
+def test_swallowed_exception_clean_when_reported_or_handled():
+    vs = check_source(_src("""
+        import logging
+        import warnings
+
+        logger = logging.getLogger(__name__)
+
+        def a(fn):
+            try:
+                return fn()
+            except OSError as e:
+                logger.warning("fn failed: %r", e)
+                return None
+
+        def b(fn):
+            try:
+                return fn()
+            except ValueError:
+                raise RuntimeError("bad input")
+
+        def c(v, enum_cls):
+            try:
+                return enum_cls(v)
+            except ValueError:
+                return enum_cls[v]      # real fallback: handled
+
+        def d(fn):
+            try:
+                fn()
+            except Exception as e:
+                warnings.warn(str(e))
+    """))
+    assert vs == []
+
+
+def test_swallowed_exception_waiver_with_reason():
+    vs = check_source(_src("""
+        import os
+
+        def cleanup(p):
+            try:
+                os.remove(p)
+            except OSError:  # photon-lint: disable=swallowed-exception (idempotent tmp cleanup)
+                pass
+            try:
+                os.remove(p + ".bak")
+            except OSError:
+                pass
+    """))
+    assert _rules(vs) == ["swallowed-exception"]
+    assert vs[0].line == 10
+
+
+# ---------------------------------------------------------------------------
 # the acceptance corpus + whole-repo gate + CLI contract
 # ---------------------------------------------------------------------------
 
@@ -737,6 +823,13 @@ _CORPUS = """
 
 
     FLAG = os.environ.get("SOME_UNSANCTIONED_FLAG")
+
+
+    def best_effort(path):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
 """
 
 
@@ -746,8 +839,9 @@ def test_fixture_corpus_detects_five_distinct_rules():
     vs = check_source(_src(_CORPUS))
     distinct = set(_rules(vs))
     assert {"jit-in-function", "tracer-hygiene", "unlocked-shared-write",
-            "accumulator-dtype", "env-read", "naked-clock"} <= distinct
-    assert len(distinct) >= 6
+            "accumulator-dtype", "env-read", "naked-clock",
+            "swallowed-exception"} <= distinct
+    assert len(distinct) >= 7
 
 
 def test_repo_clean():

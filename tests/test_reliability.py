@@ -1,0 +1,1182 @@
+"""Reliability tier (ISSUE 9): checkpoint/resume, the fault matrix,
+bounded-retry I/O, and sink hardening.
+
+The contracts under test:
+
+- **Checkpoint/resume**: the state-tree codec round-trips; CD-level
+  snapshots restore (including mid-sweep position and the corrupt-
+  newest-falls-back-to-previous rule); the streaming solvers resume
+  mid-solve BITWISE (the continuation is the run the kill
+  interrupted); streamed-RE retirement state survives a resume.
+- **Fault matrix**: every injected fault — corrupt chunk, deleted
+  chunk, slow read, transient/persistent read errors, ENOSPC on spill,
+  prefetcher/sink thread death, device_put failure, wedged pipeline —
+  ends in a bounded retry, a documented degradation, or ONE actionable
+  error, never a hang or a torn output; the ``store.retries`` /
+  ``store.gave_up`` / ``reliability.*`` telemetry counters are pinned.
+- **Sinks**: a failed write can never publish a torn container.
+- **Report**: a stitched (kill + resume, append-mode) run log
+  reconciles segment by segment.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.data.batch import make_dense_batch
+from photon_ml_tpu.data.chunk_store import (
+    ChunkStoreSpillError,
+    probe_spill_dir,
+)
+from photon_ml_tpu.data.chunked_batch import build_chunked_batch
+from photon_ml_tpu.data.normalization import NormalizationContext
+from photon_ml_tpu.data.sparse_rows import SparseRows
+from photon_ml_tpu.game.coordinate_descent import run_coordinate_descent
+from photon_ml_tpu.game.coordinates import FixedEffectCoordinate
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.regularization import RegularizationContext
+from photon_ml_tpu.optim import OptimizationProblem, OptimizerConfig
+from photon_ml_tpu.optim.streaming import (
+    ChunkPrefetcher,
+    ChunkedGLMObjective,
+    streaming_lbfgs_solve,
+    streaming_lbfgs_solve_swept,
+)
+from photon_ml_tpu.reliability import checkpoint as ckpt
+from photon_ml_tpu.reliability import faults
+from photon_ml_tpu.reliability import retry as retry_mod
+from photon_ml_tpu.reliability.checkpoint import RunCheckpointer
+from photon_ml_tpu.reliability.faults import Fault, FaultInjector
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(77)
+
+
+@contextlib.contextmanager
+def metrics_session():
+    t = telemetry.start("metrics")
+    try:
+        yield t
+    finally:
+        t.close()
+
+
+def _counters(t):
+    return t.summary()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# State-tree codec + RunCheckpointer units
+# ---------------------------------------------------------------------------
+
+
+def test_tree_codec_roundtrip():
+    tree = {
+        "w": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "jax": jnp.ones((4,)),
+        "nested": {"lists": [1, 2.5, "s", None, True,
+                             np.zeros(2, bool)]},
+        "scalar": np.float32(3.5),
+        "empty": {},
+    }
+    meta, arrays = ckpt.flatten_tree(tree)
+    json.dumps(meta)   # the manifest must be pure JSON
+    back = ckpt.unflatten_tree(meta, arrays)
+    np.testing.assert_array_equal(back["w"], tree["w"])
+    np.testing.assert_array_equal(back["jax"], np.ones(4))
+    assert back["nested"]["lists"][:5] == [1, 2.5, "s", None, True]
+    np.testing.assert_array_equal(back["nested"]["lists"][5],
+                                  np.zeros(2, bool))
+    assert float(back["scalar"]) == 3.5 and back["empty"] == {}
+
+
+def test_checkpointer_cd_roundtrip_partial_and_corrupt_fallback(tmp_path):
+    ck = RunCheckpointer(str(tmp_path), every_solver_iters=1)
+    coefs = {"a": jnp.arange(4, dtype=jnp.float32),
+             "re": [jnp.ones((2, 3)), jnp.zeros((1, 3))]}
+    scores = {"a": jnp.ones(5), "__cd_total__": jnp.full(5, 2.0)}
+    ck.save_cd(1, coefs, scores, re_state={"re": {"x": np.arange(3)}},
+               extra={"prev_values": {"a": 1.5}})
+    st = ck.load_latest_cd()
+    assert (st["iteration"], st["coord_pos"]) == (1, 0)
+    np.testing.assert_array_equal(st["coefs"]["a"], [0, 1, 2, 3])
+    assert len(st["coefs"]["re"]) == 2
+    np.testing.assert_array_equal(st["scores"]["__cd_total__"],
+                                  np.full(5, 2.0))
+    np.testing.assert_array_equal(st["re_state"]["re"]["x"],
+                                  np.arange(3))
+    assert st["extra"]["prev_values"] == {"a": 1.5}
+
+    # A partial (mid-sweep) snapshot is more advanced than its own
+    # sweep boundary and wins.
+    ck.save_cd_partial(1, 2, coefs, scores)
+    st = ck.load_latest_cd()
+    assert (st["iteration"], st["coord_pos"]) == (1, 2)
+
+    # A sweep-boundary save supersedes (and purges) the partial.
+    ck.save_cd(2, coefs, scores)
+    assert not os.path.exists(tmp_path / "cd_partial.npz")
+    st = ck.load_latest_cd()
+    assert (st["iteration"], st["coord_pos"]) == (2, 0)
+
+    # Corrupt newest snapshot degrades to the previous good one — one
+    # interval lost, never the run.
+    with open(tmp_path / "cd_iter_2.npz", "wb") as f:
+        f.write(b"garbage")
+    st = ck.load_latest_cd()
+    assert (st["iteration"], st["coord_pos"]) == (1, 0)
+
+
+def test_checkpointer_utils_compat(tmp_path):
+    """The new CD snapshot format stays readable by the legacy
+    ``utils.checkpoint`` loader (pointer is a plain int; reserved keys
+    are skipped by its parser)."""
+    from photon_ml_tpu.utils.checkpoint import load_latest_checkpoint
+
+    ck = RunCheckpointer(str(tmp_path))
+    ck.save_cd(3, {"a": jnp.arange(2, dtype=jnp.float32)},
+               {"a": jnp.ones(4)}, re_state={"z": np.ones(2)})
+    it, coefs, scores = load_latest_checkpoint(str(tmp_path))
+    assert it == 3
+    np.testing.assert_array_equal(coefs["a"], [0, 1])
+    np.testing.assert_array_equal(scores["a"], np.ones(4))
+
+
+def test_solver_checkpoint_cadence_scope_and_clear(tmp_path):
+    ck = RunCheckpointer(str(tmp_path), every_solver_iters=2,
+                         resume=True)
+    with ck.scope("it1", "coord"):
+        label = ck.solver_label("lbfgs")
+        assert label == "it1/coord/lbfgs"
+        assert not ck.maybe_save_solver(label, 1, {"w": np.ones(2)})
+        assert ck.maybe_save_solver(label, 2, {"w": np.ones(2)})
+        st = ck.load_solver(label)
+        assert st["it"] == 2
+        # Foreign scope cannot adopt this state.
+        assert ck.load_solver("it2/coord/lbfgs") is None
+        ck.clear_solver(label)
+        assert ck.load_solver(label) is None
+    # A sweep-boundary save purges any remaining solver files.
+    ck.maybe_save_solver("it1/x/lbfgs", 2, {"w": np.zeros(1)})
+    ck.save_cd(1, {}, {})
+    assert glob.glob(str(tmp_path / "solver_*.npz")) == []
+
+
+def test_stage_roundtrip(tmp_path):
+    ck = RunCheckpointer(str(tmp_path))
+    ck.save_stage("swept", {"W": np.ones((2, 3)), "sweep": 1,
+                            "lams": [1.0, 0.1]})
+    st = ck.load_stage("swept")
+    assert st["sweep"] == 1 and st["lams"] == [1.0, 0.1]
+    assert ck.load_stage("other") is None
+    ck.clear_stage("swept")
+    assert ck.load_stage("swept") is None
+
+
+# ---------------------------------------------------------------------------
+# Mid-solve resume parity (streaming solvers)
+# ---------------------------------------------------------------------------
+
+
+class _Interrupt(Exception):
+    pass
+
+
+def _quadratic(rng, n=300, d=10):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ rng.normal(size=d) + 0.1 * rng.normal(size=n)).astype(
+        np.float32)
+
+    def vg(w):
+        w = jnp.asarray(w, jnp.float32)
+        r = X @ w - y
+        return 0.5 * jnp.mean(r * r), X.T @ r / n
+
+    def vgs(W):
+        W = jnp.asarray(W, jnp.float32)
+        R = W @ X.T - y
+        return 0.5 * jnp.mean(R * R, axis=-1), R @ X / n
+
+    def vs(W):
+        W = jnp.asarray(W, jnp.float32)
+        R = W @ X.T - y
+        return 0.5 * jnp.mean(R * R, axis=-1)
+
+    return d, vg, vgs, vs
+
+
+def _flaky(fn, fail_after: int):
+    calls = {"n": 0}
+
+    def wrapped(*a):
+        calls["n"] += 1
+        if calls["n"] > fail_after:
+            raise _Interrupt()
+        return fn(*a)
+
+    return wrapped
+
+
+def test_streaming_solver_mid_solve_resume_is_bitwise(rng, tmp_path):
+    d, vg, _, _ = _quadratic(rng)
+    cfg = OptimizerConfig(max_iters=40, tolerance=1e-9)
+    ref = streaming_lbfgs_solve(vg, jnp.zeros(d), cfg, label="q")
+    ck = RunCheckpointer(str(tmp_path), every_solver_iters=1,
+                         resume=True)
+    with ckpt.session(ck), ck.scope("it1", "q"):
+        with pytest.raises(_Interrupt):
+            streaming_lbfgs_solve(_flaky(vg, 6), jnp.zeros(d), cfg,
+                                  label="q")
+        assert glob.glob(str(tmp_path / "solver_*.npz"))
+        res = streaming_lbfgs_solve(vg, jnp.zeros(d), cfg, label="q")
+    np.testing.assert_array_equal(np.asarray(res.w), np.asarray(ref.w))
+    assert int(res.iterations) == int(ref.iterations)
+    # The solver state file is cleared once the solve completes.
+    assert glob.glob(str(tmp_path / "solver_*.npz")) == []
+
+
+def test_streaming_swept_solver_mid_solve_resume_is_bitwise(rng, tmp_path):
+    d, _, vgs, vs = _quadratic(rng)
+    cfg = OptimizerConfig(max_iters=40, tolerance=1e-9)
+    W0 = jnp.zeros((3, d))
+    ref = streaming_lbfgs_solve_swept(vgs, vs, W0, cfg, label="s")
+    ck = RunCheckpointer(str(tmp_path), every_solver_iters=1,
+                         resume=True)
+    with ckpt.session(ck), ck.scope("sweep1"):
+        with pytest.raises(_Interrupt):
+            streaming_lbfgs_solve_swept(_flaky(vgs, 4), vs, W0, cfg,
+                                        label="s")
+        res = streaming_lbfgs_solve_swept(vgs, vs, W0, cfg, label="s")
+    np.testing.assert_array_equal(np.asarray(res.w), np.asarray(ref.w))
+    np.testing.assert_array_equal(np.asarray(res.iterations),
+                                  np.asarray(ref.iterations))
+
+
+def test_resumed_solver_odometer_counts_resume_not_solve(rng, tmp_path):
+    """A resumed solve must NOT claim the initial fused evaluation it
+    never streamed (the report's sweep-odometer identity)."""
+    d, vg, _, _ = _quadratic(rng)
+    cfg = OptimizerConfig(max_iters=40, tolerance=1e-9)
+    ck = RunCheckpointer(str(tmp_path), every_solver_iters=1,
+                         resume=True)
+    with ckpt.session(ck), ck.scope("it1", "q"):
+        with pytest.raises(_Interrupt):
+            streaming_lbfgs_solve(_flaky(vg, 6), jnp.zeros(d), cfg,
+                                  label="q")
+        with metrics_session() as t:
+            streaming_lbfgs_solve(vg, jnp.zeros(d), cfg, label="q")
+        c = _counters(t)
+    assert c.get("solver.resumed_solves") == 1
+    assert "solver.streamed_solves" not in c
+
+
+# ---------------------------------------------------------------------------
+# CD-level resume: mid-sweep position
+# ---------------------------------------------------------------------------
+
+
+def _two_coordinate_cd(rng, n=400):
+    x1 = rng.normal(size=(n, 5)).astype(np.float32)
+    x2 = rng.normal(size=(n, 3)).astype(np.float32)
+    labels = (rng.uniform(size=n) < 0.5).astype(np.float32)
+
+    def coord(name, x):
+        batch = make_dense_batch(x, labels)
+        return FixedEffectCoordinate(
+            name=name, batch=batch,
+            problem=OptimizationProblem(
+                objective=GLMObjective(
+                    loss=losses.LOGISTIC,
+                    reg=RegularizationContext.l2(0.5),
+                    norm=NormalizationContext.identity()),
+                config=OptimizerConfig(max_iters=30)))
+
+    return {"a": coord("a", x1), "b": coord("b", x2)}
+
+
+class _FailingCoordinate:
+    """Wraps a coordinate; ``train`` raises at a planned call (the
+    in-process stand-in for a SIGKILL mid-sweep)."""
+
+    def __init__(self, inner, fail_at_call: int):
+        self._inner = inner
+        self._fail_at = fail_at_call
+        self._calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def train(self, *a, **kw):
+        self._calls += 1
+        if self._calls == self._fail_at:
+            raise _Interrupt()
+        return self._inner.train(*a, **kw)
+
+
+def test_cd_mid_sweep_resume_parity(tmp_path):
+    """Kill during sweep 2's SECOND coordinate; resume completes it
+    and matches the uninterrupted run (restored scores make offsets
+    bitwise, so the tolerance is float-tight)."""
+    # Each build must see the SAME dataset: fresh seeded generators.
+    coords_ref = _two_coordinate_cd(np.random.default_rng(5))
+    ref = run_coordinate_descent(coords_ref, ["a", "b"], 3)
+
+    ck_dir = str(tmp_path / "ck")
+    coords = _two_coordinate_cd(np.random.default_rng(5))
+    # every_solver_iters > 0 enables coordinate-boundary partials.
+    ck = RunCheckpointer(ck_dir, every_solver_iters=1)
+    coords_failing = dict(coords)
+    # "b" trains once per sweep; its 2nd call is sweep 2's "b".
+    coords_failing["b"] = _FailingCoordinate(coords["b"], 2)
+    with pytest.raises(_Interrupt):
+        run_coordinate_descent(coords_failing, ["a", "b"], 3,
+                               checkpointer=ck)
+    st = ck.load_latest_cd()
+    assert (st["iteration"], st["coord_pos"]) == (1, 1)
+
+    coords2 = _two_coordinate_cd(np.random.default_rng(5))
+    res = run_coordinate_descent(coords2, ["a", "b"], 3,
+                                 checkpointer=RunCheckpointer(
+                                     ck_dir, every_solver_iters=1,
+                                     resume=True),
+                                 resume=True)
+    for name in ("a", "b"):
+        np.testing.assert_allclose(np.asarray(res.coefficients[name]),
+                                   np.asarray(ref.coefficients[name]),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(res.total_scores),
+                               np.asarray(ref.total_scores),
+                               rtol=1e-5, atol=1e-5)
+    assert len(res.history) == 3
+    # The resumed (partial) sweep's history entry carries BOTH
+    # coordinates: the pre-kill one rode in the partial snapshot.
+    assert set(res.history[1]) == {"a", "b"}
+    # History is uniformly typed across restored and fresh sweeps
+    # (review finding): every entry is the plain-dict diagnostic form,
+    # matching an uninterrupted run's record.
+    for result in (res, ref):
+        for entry in result.history:
+            assert all(isinstance(d, dict) for d in entry.values())
+
+
+# ---------------------------------------------------------------------------
+# Streamed-RE runtime state
+# ---------------------------------------------------------------------------
+
+
+def test_streamed_re_runtime_state_roundtrip(rng, tmp_path):
+    from photon_ml_tpu.game.coordinates import (
+        build_streamed_random_effect_coordinate,
+    )
+    from photon_ml_tpu.game.dataset import GameDataset
+
+    n = 600
+    ids = rng.integers(0, 40, n)
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    labels = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    ds = GameDataset(labels=labels, features={"re": x},
+                     entity_ids={"user": ids}, feature_dims={"re": 3})
+    obj = GLMObjective(loss=losses.LOGISTIC,
+                       reg=RegularizationContext.l2(1.0),
+                       norm=NormalizationContext.identity())
+
+    def build():
+        return build_streamed_random_effect_coordinate(
+            "user", ds, "re", obj, spill_dir=str(tmp_path / "spill"),
+            chunk_entities=8, config=OptimizerConfig(max_iters=25),
+            retirement=True)
+
+    offsets = rng.normal(0, 0.1, n).astype(np.float32)
+    c1 = build()
+    blocks1, _ = c1.train(jnp.asarray(offsets))
+    c1.retire_converged()
+    retired = c1.entities_retired
+    state = c1.runtime_state()
+
+    # A fresh coordinate (fresh process stand-in) restores the state:
+    # the returned blocks satisfy train's warm-start identity check, so
+    # retirement bookkeeping survives and the cached scores serve.
+    c2 = build()
+    blocks2, cached = c2.restore_runtime_state(state)
+    assert c2.entities_retired == retired
+    np.testing.assert_array_equal(np.asarray(c2.score(blocks2)),
+                                  np.asarray(cached))
+    b_next_1, diag1 = c1.train(jnp.asarray(offsets), warm_start=blocks1)
+    b_next_2, diag2 = c2.train(jnp.asarray(offsets), warm_start=blocks2)
+    assert diag2["entities_retired"] == diag1["entities_retired"]
+    assert diag2["entities_solved"] == diag1["entities_solved"]
+    for w1, w2 in zip(b_next_1, b_next_2):
+        np.testing.assert_allclose(np.asarray(w1), np.asarray(w2),
+                                   rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# The fault matrix
+# ---------------------------------------------------------------------------
+
+
+def _sparse_problem(rng, n=1200, d=300, k=6):
+    cols = np.stack([np.sort(rng.choice(d, k, replace=False))
+                     for _ in range(n)]).astype(np.int32)
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    labels = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    indptr = np.arange(n + 1, dtype=np.int64) * k
+    rows = SparseRows.from_flat(indptr, cols.reshape(-1).astype(np.int64),
+                                vals.reshape(-1))
+    return rows, labels, d
+
+
+def _spilled_objective(rng, spill_dir, n_chunks=6, window=2):
+    rows, labels, d = _sparse_problem(rng)
+    cb = build_chunked_batch(rows, d, labels, n_chunks=n_chunks,
+                             layout="ell", spill_dir=spill_dir,
+                             host_max_resident=window)
+    obj = GLMObjective(loss=losses.LOGISTIC,
+                       reg=RegularizationContext.l2(0.7),
+                       norm=NormalizationContext.identity())
+    return cb, ChunkedGLMObjective(obj, cb, max_resident=0,
+                                   prefetch_depth=2), d
+
+
+@pytest.mark.parametrize("kind,expect_counter", [
+    ("corrupt_file", "store.rebuilds"),
+    ("delete_file", "store.rebuilds"),
+    ("slow", "store.loads"),
+])
+def test_fault_matrix_degradations_preserve_the_run(rng, tmp_path, kind,
+                                                    expect_counter):
+    """Corrupt chunk / deleted chunk / slow read: the sweep completes
+    with the SAME value (rebuild-from-lineage or patience), and the
+    telemetry counters say what happened."""
+    cb, cobj, d = _spilled_objective(rng, str(tmp_path / "spill"))
+    w = jnp.asarray(rng.normal(0, 0.2, d), jnp.float32)
+    clean = float(cobj.value(w))
+
+    inj = FaultInjector([Fault(site="store.load", kind=kind, at=1,
+                               delay_s=0.2)])
+    with faults.injected(inj), metrics_session() as t:
+        val = float(cobj.value(w))
+    c = _counters(t)
+    assert val == pytest.approx(clean, rel=1e-6)
+    assert c.get("reliability.faults_injected", 0) >= 1
+    assert c.get(expect_counter, 0) >= 1
+    if kind in ("corrupt_file", "delete_file"):
+        # The rebuild re-spilled a good file: the NEXT sweep is clean.
+        with metrics_session() as t2:
+            assert float(cobj.value(w)) == pytest.approx(clean,
+                                                         rel=1e-6)
+        assert _counters(t2).get("store.rebuilds", 0) == 0
+
+
+def test_fault_matrix_transient_read_error_retries(rng, tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setattr(retry_mod, "IO_BASE_DELAY_S", 0.01)
+    cb, cobj, d = _spilled_objective(rng, str(tmp_path / "spill"))
+    w = jnp.asarray(rng.normal(0, 0.2, d), jnp.float32)
+    clean = float(cobj.value(w))
+    inj = FaultInjector([Fault(site="store.load", kind="io_error",
+                               at=1, count=1)])
+    with faults.injected(inj), metrics_session() as t:
+        val = float(cobj.value(w))
+    c = _counters(t)
+    assert val == pytest.approx(clean, rel=1e-6)
+    assert c.get("store.retries", 0) == 1      # one backoff retry won
+    assert c.get("store.gave_up", 0) == 0
+    assert c.get("store.rebuilds", 0) == 0     # never reached lineage
+
+
+def test_fault_matrix_persistent_read_error_gives_up_then_rebuilds(
+        rng, tmp_path, monkeypatch):
+    monkeypatch.setattr(retry_mod, "IO_BASE_DELAY_S", 0.01)
+    cb, cobj, d = _spilled_objective(rng, str(tmp_path / "spill"))
+    w = jnp.asarray(rng.normal(0, 0.2, d), jnp.float32)
+    clean = float(cobj.value(w))
+    inj = FaultInjector([Fault(site="store.load", kind="io_error",
+                               at=1, count=3)])   # the whole budget
+    with faults.injected(inj), metrics_session() as t:
+        val = float(cobj.value(w))
+    c = _counters(t)
+    assert val == pytest.approx(clean, rel=1e-6)
+    assert c.get("store.retries", 0) == 2      # attempts 2 and 3
+    assert c.get("store.gave_up", 0) == 1      # budget exhausted once
+    assert c.get("store.rebuilds", 0) == 1     # lineage took over
+
+
+def test_fault_matrix_enospc_is_one_actionable_error(rng, tmp_path):
+    rows, labels, d = _sparse_problem(rng)
+    inj = FaultInjector([Fault(site="store.spill", kind="enospc",
+                               at=0, count=100)])
+    spill = str(tmp_path / "spill")
+    with faults.injected(inj), metrics_session() as t:
+        with pytest.raises(ChunkStoreSpillError) as ei:
+            build_chunked_batch(rows, d, labels, n_chunks=6,
+                                layout="ell", spill_dir=spill)
+    msg = str(ei.value)
+    assert spill in msg and "MB" in msg and "out of space" in msg
+    assert ei.value.bytes_needed > 0
+    assert _counters(t).get("reliability.actionable_errors", 0) == 1
+
+
+@pytest.mark.parametrize("site", ["prefetch.load", "prefetch.place"])
+def test_fault_matrix_prefetch_thread_death_is_in_band(rng, tmp_path,
+                                                       site):
+    """A dead prefetcher (disk-read or device_put stage) surfaces as
+    the ONE injected error on the consumer thread — no hang, and the
+    store quiesces (no leaked reader)."""
+    cb, cobj, d = _spilled_objective(rng, str(tmp_path / "spill"))
+    w = jnp.asarray(rng.normal(0, 0.2, d), jnp.float32)
+    inj = FaultInjector([Fault(site=site, kind="error", at=2)])
+    with faults.injected(inj):
+        with pytest.raises(faults.InjectedFault):
+            cobj.value(w)
+    cb.store.assert_quiesced()
+    # The pipeline is reusable after the failure.
+    assert np.isfinite(float(cobj.value(w)))
+
+
+def test_fault_matrix_wedged_pipeline_times_out_not_hangs():
+    """A load that never returns trips the consumer's stall deadline
+    into an actionable TimeoutError instead of an eternal q.get."""
+    block = threading.Event()
+
+    def load(i):
+        block.wait(30)
+        return i
+
+    pf = ChunkPrefetcher(load, lambda h: h, depth=2,
+                         stall_timeout_s=0.3)
+    pf.start(range(2))
+    try:
+        with pytest.raises(TimeoutError, match="stalled"):
+            pf.next(0)
+        # close() must not re-hang while the producer is STILL wedged
+        # inside the load (review finding): bounded join, then abandon
+        # the daemon thread.
+        pf.close(join_timeout_s=0.2)
+        assert pf._thread is None
+    finally:
+        block.set()
+
+
+def test_fault_matrix_dead_producer_is_actionable():
+    """A producer thread that vanished without a sentinel (the
+    killed-thread shape) raises immediately, never blocks forever."""
+    pf = ChunkPrefetcher(lambda i: i, lambda h: h, depth=1,
+                         stall_timeout_s=5.0)
+    pf.start(range(1))
+    assert pf.next(0) == 0
+    # The thread has exhausted its order and exited; asking for more
+    # is the orphaned-consumer shape.
+    pf._thread.join(timeout=5)
+    with pytest.raises(RuntimeError, match="died without delivering"):
+        pf.next(1)
+    pf.close()
+
+
+def test_fault_matrix_unwritable_spill_dir_degrades_resident(rng,
+                                                             tmp_path):
+    """An unwritable spill dir degrades to the resident build with one
+    warning — the run loses the memory bound, not its life."""
+    blocker = tmp_path / "blocked"
+    blocker.write_text("a file, not a dir")
+    spill = str(blocker / "spill")
+    assert probe_spill_dir(spill) is None
+    rows, labels, d = _sparse_problem(rng)
+    with metrics_session() as t:
+        cb = build_chunked_batch(rows, d, labels, n_chunks=4,
+                                 layout="ell", spill_dir=spill)
+    assert cb.store is None          # resident fallback
+    assert cb.n_chunks == 4
+
+
+def test_fault_matrix_seeded_plan_is_deterministic():
+    p1 = faults.seeded_plan(7, {"store.load": "io_error",
+                                "store.spill": "enospc"})
+    p2 = faults.seeded_plan(7, {"store.load": "io_error",
+                                "store.spill": "enospc"})
+    at1 = sorted((f.site, f.kind, f.at)
+                 for fs in p1._by_site.values() for f in fs)
+    at2 = sorted((f.site, f.kind, f.at)
+                 for fs in p2._by_site.values() for f in fs)
+    assert at1 == at2
+
+
+# ---------------------------------------------------------------------------
+# Sink hardening: no torn containers, ever
+# ---------------------------------------------------------------------------
+
+
+def _scoring_workload(rng, n=400):
+    from photon_ml_tpu.game.dataset import GameDataset
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.models.game import FixedEffectModel, GameModel
+    from photon_ml_tpu.models.glm import TaskType
+
+    d = 20
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    model = GameModel(models={
+        "global": FixedEffectModel(
+            coefficients=Coefficients(
+                means=jnp.asarray(rng.normal(size=d + 1)
+                                  .astype(np.float32))),
+            feature_shard="dense", intercept=True)})
+    ds = GameDataset(
+        labels=(rng.uniform(size=n) < 0.5).astype(np.float32),
+        features={"dense": x}, entity_ids={})
+    return model, ds, TaskType.LOGISTIC_REGRESSION
+
+
+def test_sink_writer_death_leaves_no_torn_output(rng, tmp_path):
+    from photon_ml_tpu.estimators.streaming_scorer import (
+        StreamingGameScorer,
+    )
+    from photon_ml_tpu.io.score_sink import AvroScoreSink, NpzScoreSink
+
+    model, ds, task = _scoring_workload(rng)
+    npz_path = str(tmp_path / "scores.npz")
+    avro_path = str(tmp_path / "scores.avro")
+    sinks = [NpzScoreSink(npz_path, ds.n),
+             AvroScoreSink(avro_path, codec="null")]
+    scorer = StreamingGameScorer(model, task, chunk_rows=64)
+    inj = FaultInjector([Fault(site="sink.write", kind="error", at=1)])
+    with faults.injected(inj):
+        with pytest.raises(faults.InjectedFault):
+            scorer.score(ds, sinks=sinks)
+    # No published outputs, no tmp orphans: the failure is loud and
+    # the directory is clean.
+    leftovers = [p for p in os.listdir(tmp_path)]
+    assert leftovers == [], leftovers
+
+
+def test_avro_sink_refuses_close_after_torn_write(rng, tmp_path):
+    from photon_ml_tpu.io.score_sink import AvroScoreSink
+
+    path = str(tmp_path / "s.avro")
+    sink = AvroScoreSink(path, codec="null")
+    sink.write(0, 4, None, np.ones(4), np.zeros(4))
+    good_end = sink._f.tell()
+
+    class _FailingFile:
+        def __init__(self, f):
+            self._f = f
+            self._writes = 0
+
+        def write(self, b):
+            self._writes += 1
+            if self._writes >= 2:     # fail mid-block
+                raise OSError("disk error")
+            return self._f.write(b)
+
+        def __getattr__(self, name):
+            return getattr(self._f, name)
+
+    real = sink._f
+    sink._f = _FailingFile(real)
+    with pytest.raises(OSError):
+        sink.write(4, 8, None, np.ones(4), np.zeros(4))
+    sink._f = real
+    # Rolled back to the block boundary; close refuses to publish.
+    assert real.tell() == good_end
+    with pytest.raises(ValueError, match="partial container"):
+        sink.close()
+    assert not os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_npz_sink_refuses_close_after_failed_write(tmp_path):
+    from photon_ml_tpu.io.score_sink import NpzScoreSink
+
+    path = str(tmp_path / "s.npz")
+    sink = NpzScoreSink(path, 8)
+    sink.write(0, 4, np.ones(4), np.ones(4), np.zeros(4))
+    with pytest.raises(Exception):
+        sink.write(4, 8, np.ones(3), np.ones(4), np.zeros(4))  # bad shape
+    with pytest.raises(ValueError, match="rows written"):
+        sink.close()
+    assert os.listdir(tmp_path) == []   # all tmp members cleaned
+
+
+# ---------------------------------------------------------------------------
+# Stitched run-log report (kill + resume, append mode)
+# ---------------------------------------------------------------------------
+
+
+def test_report_splits_stitched_segments(tmp_path, capsys):
+    from photon_ml_tpu.telemetry.report import report
+    from photon_ml_tpu.utils.run_log import RunLogger
+
+    path = str(tmp_path / "run_log.jsonl")
+    with RunLogger(path, run_info={"telemetry": "off"}) as log:
+        log.event("phase_end", phase="fit", duration_s=1.0)
+    # Torn tail: the killed run died mid-write.
+    with open(path, "a") as f:
+        f.write('{"t": 9.9, "event": "cd_coo')
+    with RunLogger(path, mode="a", header=True,
+                   run_info={"telemetry": "off", "resume": True}) as log:
+        log.event("cd_resume", iteration=1)
+        log.event("phase_end", phase="fit", duration_s=0.5)
+
+    result = report(path, out=None)
+    out = capsys.readouterr().out
+    assert result["segments"] == 2
+    assert result["ok"] is True
+    # The report of record is the LAST segment's.
+    assert result["phases"] == {"fit": 0.5}
+    assert "Stitched log: 2 run segments" in out
+    assert "malformed line" in out
+
+
+# ---------------------------------------------------------------------------
+# Driver-level resume: the swept streamed fit
+# ---------------------------------------------------------------------------
+
+
+def _driver_config(tmp_path, out, n_iterations=2, resume=False,
+                   train="train.jsonl"):
+    return {
+        "task_type": "LOGISTIC_REGRESSION",
+        "coordinates": [{
+            "name": "global", "kind": "FIXED_EFFECT",
+            "feature_shard": "global",
+            "optimizer": {"optimizer": "LBFGS", "max_iters": 40,
+                          "tolerance": 1e-8},
+        }],
+        "update_sequence": ["global"],
+        "input_path": str(tmp_path / train),
+        "validation_fraction": 0.25,
+        "output_dir": str(tmp_path / out),
+        "n_iterations": n_iterations,
+        "reg_weight_grid": {"global": [2.0, 0.5, 0.1]},
+        "chunk_rows": 128,
+        "spill_dir": str(tmp_path / "spill"),
+        "checkpoint_dir": str(tmp_path / "ck"),
+        "checkpoint_every_solver_iters": 1,
+        "resume": resume,
+        "seed": 3,
+    }
+
+
+def _fixed_coefs(model_dir):
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    model, _task = load_game_model(str(model_dir))
+    return np.asarray(model.models["global"].coefficients.means)
+
+
+def test_driver_swept_streamed_resume_parity(tmp_path):
+    """The acceptance shape in-process: a swept streamed grid fit that
+    completed only sweep 1 of 2 resumes (--resume semantics through the
+    driver) and lands on the uninterrupted run's coefficients."""
+    import json as _json
+
+    from photon_ml_tpu.cli import game_training_driver
+
+    from test_drivers import _write_jsonl_fixture
+
+    _write_jsonl_fixture(str(tmp_path / "train.jsonl"), n_users=10,
+                         n_obs=800, seed=9)
+
+    # Uninterrupted 2-sweep fit (its own checkpoint dir).
+    cfg = _driver_config(tmp_path, "out_full")
+    cfg["checkpoint_dir"] = str(tmp_path / "ck_full")
+    p = str(tmp_path / "cfg_full.json")
+    with open(p, "w") as f:
+        _json.dump(cfg, f)
+    summary_full = game_training_driver.main(["--config", p])
+
+    # "Interrupted": sweep 1 only, checkpointed...
+    cfg1 = _driver_config(tmp_path, "out_resumed", n_iterations=1)
+    p1 = str(tmp_path / "cfg1.json")
+    with open(p1, "w") as f:
+        _json.dump(cfg1, f)
+    game_training_driver.main(["--config", p1])
+    assert os.path.exists(tmp_path / "ck" / "stage_swept.npz")
+
+    # ...then resumed to the full 2 sweeps.
+    cfg2 = _driver_config(tmp_path, "out_resumed", n_iterations=2,
+                          resume=True)
+    p2 = str(tmp_path / "cfg2.json")
+    with open(p2, "w") as f:
+        _json.dump(cfg2, f)
+    summary_res = game_training_driver.main(["--config", p2])
+
+    assert summary_res["best_index"] == summary_full["best_index"]
+    w_full = _fixed_coefs(tmp_path / "out_full" / "model")
+    w_res = _fixed_coefs(tmp_path / "out_resumed" / "model")
+    np.testing.assert_allclose(w_res, w_full, rtol=1e-5, atol=1e-6)
+    # The stitched run log carries both segments.
+    from photon_ml_tpu.telemetry.report import split_segments
+    from photon_ml_tpu.utils.run_log import read_run_log
+
+    segs = split_segments(read_run_log(
+        str(tmp_path / "out_resumed" / "run_log.jsonl")))
+    assert len(segs) == 2
+
+
+@pytest.mark.slow
+def test_driver_sigkill_then_resume_e2e(tmp_path):
+    """THE acceptance e2e: SIGKILL a subprocess swept streamed driver
+    fit mid-solve, ``--resume``, assert coefficient parity with an
+    uninterrupted run and that ``telemetry report`` reconciles the
+    stitched log (rc 0, two segments)."""
+    import json as _json
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    from test_drivers import _write_jsonl_fixture
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    _write_jsonl_fixture(str(tmp_path / "train.jsonl"), n_users=12,
+                         n_obs=6000, seed=11)
+
+    def cfg(out, ck, resume):
+        c = _driver_config(tmp_path, out, n_iterations=2, resume=resume)
+        c["checkpoint_dir"] = str(tmp_path / ck)
+        c["telemetry"] = "trace"
+        # Long enough to be killable mid-solve on any box.
+        c["coordinates"][0]["optimizer"]["max_iters"] = 400
+        c["coordinates"][0]["optimizer"]["tolerance"] = 1e-12
+        return c
+
+    def run(name, config, wait=True):
+        path = str(tmp_path / f"{name}.json")
+        with open(path, "w") as f:
+            _json.dump(config, f)
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "photon_ml_tpu.cli.game_training_driver",
+             "--config", path],
+            cwd=repo, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        if wait:
+            assert proc.wait(timeout=900) == 0
+        return proc
+
+    # Uninterrupted reference.
+    run("full", cfg("out_full", "ck_full", False))
+
+    # Victim: SIGKILL once the first mid-solve snapshot lands.
+    proc = run("victim", cfg("out_res", "ck", False), wait=False)
+    deadline = time.monotonic() + 600
+    ck_dir = str(tmp_path / "ck")
+    try:
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                pytest.fail("driver finished before a mid-solve "
+                            "checkpoint appeared; shape too small")
+            if glob.glob(os.path.join(ck_dir, "solver_*.npz")):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("no solver checkpoint appeared in time")
+        time.sleep(0.5)   # let a cadence tick or two land
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # Resume to completion.
+    run("resume", cfg("out_res", "ck", True))
+
+    w_full = _fixed_coefs(tmp_path / "out_full" / "model")
+    w_res = _fixed_coefs(tmp_path / "out_res" / "model")
+    np.testing.assert_allclose(w_res, w_full, rtol=1e-4, atol=1e-5)
+
+    # telemetry report reconciles the stitched (kill + resume) log.
+    import subprocess as sp
+
+    proc = sp.run(
+        [sys.executable, "-m", "photon_ml_tpu.telemetry", "report",
+         str(tmp_path / "out_res" / "run_log.jsonl")],
+        cwd=repo, env=env, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    tail = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert tail["segments"] == 2
+    assert tail["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# Tuner history checkpointing (swept batched tuning)
+# ---------------------------------------------------------------------------
+
+
+def test_tuned_swept_checkpoint_restores_history_and_models(tmp_path):
+    """A resumed swept batched tuning run replays the checkpointed
+    rounds as observations and materializes completed trials' models
+    from the saved lane matrices — no re-training."""
+    from photon_ml_tpu.config import (
+        CoordinateConfig,
+        CoordinateKind,
+        OptimizerSettings,
+        TrainingConfig,
+        TuningConfig,
+    )
+    from photon_ml_tpu.estimators.game_estimator import GameEstimator
+    from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+    from photon_ml_tpu.game.dataset import GameDataset
+    from photon_ml_tpu.models.glm import TaskType
+
+    rng = np.random.default_rng(3)
+    rows, labels, d = _sparse_problem(rng, n=800, d=60, k=4)
+    train = GameDataset(labels=labels, features={"global": rows},
+                        entity_ids={}, feature_dims={"global": d})
+    rows_v, labels_v, _ = _sparse_problem(rng, n=300, d=60, k=4)
+    valid = GameDataset(labels=labels_v, features={"global": rows_v},
+                        entity_ids={}, feature_dims={"global": d})
+
+    def config(resume):
+        return TrainingConfig(
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            coordinates=[CoordinateConfig(
+                name="global", kind=CoordinateKind.FIXED_EFFECT,
+                feature_shard="global",
+                optimizer=OptimizerSettings(max_iters=25))],
+            update_sequence=["global"],
+            evaluators=[EvaluatorType.AUC],
+            tuning=TuningConfig(
+                n_trials=4, mode="RANDOM", trial_batch=2, seed=1,
+                reg_weight_ranges={"global": {"low": 0.01,
+                                              "high": 10.0}}),
+            checkpoint_dir=str(tmp_path / "ck"),
+            output_dir=str(tmp_path / "out"),
+            resume=resume, seed=0)
+
+    res1 = GameEstimator(config(False)).fit_tuned(train, valid)
+    # One stage file per completed round (2 rounds of trial_batch=2) —
+    # each round writes only its own lane matrix (review finding: a
+    # cumulative snapshot re-serialized every prior round each round).
+    assert os.path.exists(tmp_path / "ck" / "stage_tuner_hist_0.npz")
+    assert os.path.exists(tmp_path / "ck" / "stage_tuner_hist_1.npz")
+
+    res2 = GameEstimator(config(True)).fit_tuned(train, valid)
+    assert len(res2) == len(res1) == 4
+    for a, b in zip(res1, res2):
+        assert a.reg_weights == b.reg_weights
+        assert a.evaluations == b.evaluations
+        # Restored trials keep the per-sweep validation trace a live
+        # run carries (review finding).
+        assert a.validation_history == b.validation_history
+        assert len(b.validation_history) > 0
+        np.testing.assert_allclose(
+            np.asarray(a.model.models["global"].coefficients.means),
+            np.asarray(b.model.models["global"].coefficients.means),
+            rtol=1e-6, atol=1e-7)
+
+
+def test_fresh_run_claims_dir_so_resume_never_jumps_runs(tmp_path):
+    """A fresh run's first checkpoint write removes a PREVIOUS run's
+    snapshots from the directory (review finding): without the claim, a
+    fresh run killed at sweep 2 into a dir holding an older run's
+    cd_iter_5 would --resume at the foreign sweep 5."""
+    old = RunCheckpointer(str(tmp_path), every_solver_iters=1)
+    old.save_cd(5, {"a": jnp.full(3, 9.0)}, {})
+    old.save_stage("swept", {"sweep": 5, "lams": [1.0]})
+    assert old.maybe_save_solver("it5/a/lbfgs", 1, {"w": np.ones(2)})
+
+    fresh = RunCheckpointer(str(tmp_path))
+    fresh.save_cd(1, {"a": jnp.arange(3, dtype=jnp.float32)}, {})
+    assert not os.path.exists(tmp_path / "cd_iter_5.npz")
+    assert not os.path.exists(tmp_path / "stage_swept.npz")
+    assert glob.glob(str(tmp_path / "solver_*.npz")) == []
+
+    resumed = RunCheckpointer(str(tmp_path), resume=True)
+    st = resumed.load_latest_cd()
+    assert st["iteration"] == 1
+    np.testing.assert_array_equal(st["coefs"]["a"], [0, 1, 2])
+    # A RESUMED run never claims: its own predecessor's files survive
+    # its writes.
+    resumed.save_cd(2, {"a": jnp.zeros(3)}, {})
+    assert os.path.exists(tmp_path / "cd_iter_1.npz")
+
+
+def test_legacy_format_checkpoint_resumes(tmp_path):
+    """--resume into a directory checkpointed by the pre-reliability
+    release (``utils.checkpoint``: plain np.savez, no manifest) must
+    restore the run, not silently restart at sweep 0 (review
+    finding)."""
+    from photon_ml_tpu.utils.checkpoint import save_checkpoint
+
+    save_checkpoint(str(tmp_path), 4,
+                    {"a": jnp.arange(3, dtype=jnp.float32),
+                     "re": [jnp.ones((2, 2))]},
+                    {"a": jnp.ones(5)})
+    ck = RunCheckpointer(str(tmp_path), resume=True)
+    st = ck.load_latest_cd()
+    assert st is not None
+    assert (st["iteration"], st["coord_pos"]) == (4, 0)
+    np.testing.assert_array_equal(st["coefs"]["a"], [0, 1, 2])
+    np.testing.assert_array_equal(st["coefs"]["re"][0], np.ones((2, 2)))
+    np.testing.assert_array_equal(st["scores"]["a"], np.ones(5))
+    assert st["re_state"] == {} and st["extra"] == {}
+
+    # A newer new-format snapshot still dominates the legacy one.
+    ck.save_cd(5, {"a": jnp.zeros(3)}, {})
+    st = ck.load_latest_cd()
+    assert st["iteration"] == 5
+    np.testing.assert_array_equal(st["coefs"]["a"], np.zeros(3))
+
+
+def test_resumed_random_search_continues_the_proposal_stream():
+    """A resumed random search proposes the rounds AFTER the restored
+    ones, not round 0's draws again (review finding): run_batched
+    replays the strategy's proposal stream past the restored trials."""
+    from photon_ml_tpu.hyperparameter.search import (
+        ParamRange,
+        SearchSpace,
+    )
+    from photon_ml_tpu.hyperparameter.tuner import (
+        HyperparameterTuner,
+        TunerMode,
+    )
+
+    def make():
+        return HyperparameterTuner(
+            SearchSpace([ParamRange("lam", 0.01, 10.0)]),
+            mode=TunerMode.RANDOM, seed=7)
+
+    proposed: list[list[dict]] = []
+
+    def evaluate(configs):
+        proposed.append([dict(c) for c in configs])
+        return [(float(c["lam"]), None) for c in configs]
+
+    trials = make().run_batched(evaluate, 6, batch_size=2)
+    rounds_full = list(proposed)
+    assert len(rounds_full) == 3
+
+    proposed.clear()
+    restored = [(t.config, t.metric, t.payload) for t in trials[:2]]
+    trials2 = make().run_batched(evaluate, 6, batch_size=2,
+                                 restored=restored)
+    # Rounds 1 and 2 are evaluated — never a re-draw of round 0.
+    assert proposed == rounds_full[1:]
+    assert [t.config for t in trials2] == [t.config for t in trials]
+
+
+def test_swept_stage_checkpoint_honors_sweep_cadence(tmp_path,
+                                                     monkeypatch):
+    """``checkpoint_every_sweeps`` gates the swept path's per-sweep
+    lane snapshot exactly like maybe_save_cd (review finding); the
+    final sweep always saves."""
+    from photon_ml_tpu.config import (
+        CoordinateConfig,
+        CoordinateKind,
+        OptimizerSettings,
+        TrainingConfig,
+    )
+    from photon_ml_tpu.estimators.game_estimator import GameEstimator
+    from photon_ml_tpu.game.dataset import GameDataset
+    from photon_ml_tpu.models.glm import TaskType
+
+    rng = np.random.default_rng(5)
+    rows, labels, d = _sparse_problem(rng, n=600, d=40, k=4)
+    train = GameDataset(labels=labels, features={"global": rows},
+                        entity_ids={}, feature_dims={"global": d})
+
+    saves: list[tuple[str, int]] = []
+    orig = RunCheckpointer.save_stage
+
+    def spy(self, name, tree):
+        saves.append((name, tree.get("sweep")))
+        return orig(self, name, tree)
+
+    monkeypatch.setattr(RunCheckpointer, "save_stage", spy)
+    cfg = TrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinates=[CoordinateConfig(
+            name="global", kind=CoordinateKind.FIXED_EFFECT,
+            feature_shard="global",
+            optimizer=OptimizerSettings(max_iters=15))],
+        update_sequence=["global"],
+        reg_weight_grid={"global": [2.0, 0.5]},
+        n_iterations=3,
+        checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_every_sweeps=2,
+        output_dir=str(tmp_path / "out"),
+        seed=0)
+    GameEstimator(cfg).fit(train)
+    # Sweeps 1..3 at cadence 2: sweep 2 (on cadence) + sweep 3 (final).
+    assert [s for s in saves if s[0] == "swept"] == [("swept", 2),
+                                                     ("swept", 3)]
+
+
+def test_fresh_run_never_adopts_stale_solver_state(rng, tmp_path):
+    """A NON-resume run into a dirty checkpoint dir (crashed
+    predecessor) must not inherit its mid-solve state (review
+    finding): only --resume adopts solver snapshots."""
+    d, vg, _, _ = _quadratic(rng)
+    cfg = OptimizerConfig(max_iters=40, tolerance=1e-9)
+    crashed = RunCheckpointer(str(tmp_path), every_solver_iters=1,
+                              resume=True)
+    with ckpt.session(crashed), crashed.scope("it1", "q"):
+        with pytest.raises(_Interrupt):
+            streaming_lbfgs_solve(_flaky(vg, 6), jnp.zeros(d), cfg,
+                                  label="q")
+    assert glob.glob(str(tmp_path / "solver_*.npz"))
+
+    fresh = RunCheckpointer(str(tmp_path), every_solver_iters=1)
+    assert fresh.load_solver("it1/streaming_lbfgs:q/q") is None
+    with ckpt.session(fresh), fresh.scope("it1", "q"), \
+            metrics_session() as t:
+        streaming_lbfgs_solve(vg, jnp.zeros(d), cfg, label="q")
+    c = _counters(t)
+    # A full fresh solve: counted as a solve, never as a resume.
+    assert c.get("solver.streamed_solves") == 1
+    assert "solver.resumed_solves" not in c
+
+
+def test_solver_snapshot_rejected_on_objective_change(rng, tmp_path):
+    """Mid-solve snapshots are identity-stamped (warm start + l1 + m):
+    resuming after a config edit that keeps shapes and scope (new λ
+    values, changed warm path) runs a FULL solve instead of silently
+    adopting the stale loop state (review finding)."""
+    d, vg, _, _ = _quadratic(rng)
+    cfg = OptimizerConfig(max_iters=40, tolerance=1e-9)
+    crashed = RunCheckpointer(str(tmp_path), every_solver_iters=1,
+                              resume=True)
+    with ckpt.session(crashed), crashed.scope("it1", "q"):
+        with pytest.raises(_Interrupt):
+            streaming_lbfgs_solve(_flaky(vg, 6), jnp.zeros(d), cfg,
+                                  label="q")
+    assert glob.glob(str(tmp_path / "solver_*.npz"))
+
+    resumed = RunCheckpointer(str(tmp_path), every_solver_iters=1,
+                              resume=True)
+    with ckpt.session(resumed), resumed.scope("it1", "q"), \
+            metrics_session() as t:
+        streaming_lbfgs_solve(vg, jnp.ones(d), cfg, label="q")
+    c = _counters(t)
+    # Different warm start ⇒ fingerprint mismatch ⇒ full solve.
+    assert c.get("solver.streamed_solves") == 1
+    assert "solver.resumed_solves" not in c
+
+
+def test_run_logger_append_to_empty_file_is_clean(tmp_path):
+    """--resume pointed at an empty (or never-flushed) predecessor log
+    must not crash the torn-tail repair (review finding)."""
+    path = str(tmp_path / "run_log.jsonl")
+    open(path, "w").close()
+    from photon_ml_tpu.utils.run_log import RunLogger, read_run_log
+
+    with RunLogger(path, mode="a", header=True,
+                   run_info={"resume": True}) as log:
+        log.event("x")
+    events = read_run_log(path)
+    assert [e["event"] for e in events] == ["run_header", "x"]
