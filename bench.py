@@ -268,6 +268,7 @@ class BenchContext:
         self.cache_dir = args.cache_dir
         self.no_compile_cache = args.no_compile_cache
         self.guards = args.guards
+        self.monitor = getattr(args, "monitor", False)
         self.deadline = time.time() + args.budget_s
         self.budget_s = args.budget_s
         self.record: dict = {}
@@ -771,6 +772,10 @@ def _telemetry_block(summary: dict, sweeps_key: str = "solver.sweeps") -> dict:
         "store_hits": c.get("store.hits", 0),
         "store_loads": c.get("store.loads", 0),
         "compiles": c.get("jax.compiles", 0),
+        # Live-monitor event counters (ISSUE 10): the monitoring-off
+        # default must read 0 here — the contract test pins it.
+        "progress_events": c.get("monitor.progress_events", 0),
+        "alerts": c.get("monitor.alerts", 0),
         # Captured XLA program costs (ISSUE 8): whatever the arm's
         # instrumented paths resolved during the telemetry window.
         "device_cost": summary.get("device", {}).get("programs") or None,
@@ -847,6 +852,18 @@ def stream_arm_main(args) -> int:
     from photon_ml_tpu import telemetry
 
     tel = telemetry.start("metrics")
+    # --monitor (ISSUE 10): the live monitor — snapshot throttling,
+    # online alert evaluation, AND the ephemeral /status endpoint
+    # thread — spans the timed sweeps, so the pass_ms delta vs an
+    # unmonitored arm IS the monitoring overhead the ≤2% acceptance
+    # budget gates.  Off stays the default: no monitor session, no
+    # status thread, zero `progress` events (the contract test pins
+    # both states).
+    mon = None
+    if args.monitor:
+        from photon_ml_tpu.telemetry import monitor as _mon
+
+        mon = _mon.start(status_port=0)
     # Device cost (ISSUE 8) rides the IN-SWEEP capture on the first
     # timed pass: it reuses the chunk that pass already loaded (an
     # explicit pre-capture here would bump store.hits/loads with an
@@ -872,6 +889,23 @@ def stream_arm_main(args) -> int:
             g = cobj.value_and_gradient(w0)[1]
             jax.block_until_ready(g)
             times.append(time.time() - t0)
+    progress_block = None
+    status_ok = None
+    if mon is not None:
+        # Prove the endpoint is live from inside the measured arm: one
+        # localhost GET against the ephemeral port, parsed as JSON.
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{mon.status_port}/status",
+                    timeout=5) as resp:
+                status_ok = bool(json.load(resp).get("stages"))
+        except OSError as e:
+            status_ok = False
+            print(f"status endpoint probe failed: {e}", file=sys.stderr)
+        progress_block = mon.summary()
+        mon.close()
     tel_summary = tel.summary()
     tel.close()
     # Median, not mean: single passes on a small shared host jitter
@@ -911,6 +945,11 @@ def stream_arm_main(args) -> int:
         "device_cost": tel_summary.get("device", {}).get(
             "programs", {}).get("chunk_vg"),
     }
+    if progress_block is not None:
+        # The monitoring-on contract: stage snapshots + alerts + the
+        # endpoint probe ride the arm's JSON.
+        rec["progress"] = progress_block
+        rec["status_ok"] = status_ok
     if compile_log is not None:
         rec["guards"] = {
             # Steady-state sweeps must compile nothing; a retrace here
@@ -958,7 +997,8 @@ def section_stream(ctx: BenchContext) -> None:
              "--stream-arm", arm, "--n", str(ctx.n), "--d", str(ctx.d),
              "--k", str(ctx.k), "--cache-dir", ctx.cache_dir]
             + (["--no-compile-cache"] if ctx.no_compile_cache else [])
-            + (["--guards"] if ctx.guards else []),
+            + (["--guards"] if ctx.guards else [])
+            + (["--monitor"] if ctx.monitor else []),
             capture_output=True, text=True,
             timeout=max(60.0, ctx.remaining()),
         )
@@ -992,6 +1032,7 @@ def section_stream(ctx: BenchContext) -> None:
         "prefetch_depth": STREAM_DEPTH,
         "sweeps_timed": STREAM_SWEEPS,
         "layout": "ell",
+        "monitor": ctx.monitor,
         "spilled": spilled,
         "resident": resident,
         # The two acceptance numbers: how much smaller the spilled
@@ -1578,6 +1619,13 @@ def main(argv: list[str] | None = None) -> int:
                         "jax.transfer_guard('log') over the per-chunk "
                         "dispatch loop; results land in the section "
                         "record under 'guards'")
+    p.add_argument("--monitor", action="store_true",
+                   help="run the stream arms with the live monitor on "
+                        "(ISSUE 10): progress snapshots + online alert "
+                        "evaluation + an ephemeral /status endpoint "
+                        "span the timed sweeps, and each arm's JSON "
+                        "embeds its 'progress' block — the knob the "
+                        "monitoring-overhead measurement flips")
     p.add_argument("--stream-arm", choices=("spilled", "resident"),
                    default=None,
                    help="internal: run ONE arm of the stream section "

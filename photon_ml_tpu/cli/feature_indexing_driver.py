@@ -31,20 +31,27 @@ def run(input_path: str, output_dir: str,
         shards: list[str] | None = None,
         entity_keys: list[str] | None = None,
         log: RunLogger | None = None,
-        telemetry_mode: str = "off") -> dict:
+        telemetry_mode: str = "off",
+        monitor: str = "off",
+        status_port: int | None = None) -> dict:
     # Indexing itself is host-only, but wire the compilation cache
     # like the other drivers so $PHOTON_ML_TPU_COMPILE_CACHE covers any
     # jax use behind the I/O layer uniformly.
     from photon_ml_tpu import telemetry
     from photon_ml_tpu.cache import enable_compilation_cache
+    from photon_ml_tpu.telemetry import monitor as _mon
 
     enable_compilation_cache()
     # Context-managed logger + optional telemetry session (the driver
     # knob discipline of the other two drivers): the scan phase becomes
-    # a span and the summary/trace land under the output dir.
+    # a span and the summary/trace land under the output dir.  The
+    # monitor/status knobs match too (ISSUE 10) — a large scan is a
+    # silent single phase without them.
     with (log or RunLogger()) as log, \
             telemetry.maybe_session(telemetry_mode, output_dir,
-                                    run_logger=log):
+                                    run_logger=log), \
+            _mon.maybe_monitor(monitor == "on", run_logger=log,
+                               status_port=status_port):
         with log.timed("build_index_maps", input=input_path):
             feature_maps, entity_maps = build_index_maps(
                 input_path, shards, entity_keys
@@ -73,9 +80,18 @@ def main(argv: list[str] | None = None) -> dict:
                         default="off",
                         help="pipeline telemetry for the scan phase "
                              "(summary/trace land in --output-dir)")
+    parser.add_argument("--monitor", choices=("off", "on"),
+                        default="off",
+                        help="live progress snapshots + online alerts "
+                             "in the run log (ISSUE 10)")
+    parser.add_argument("--status-port", type=int, default=None,
+                        help="serve GET /status + /metrics from a "
+                             "localhost thread on this port (0 = "
+                             "ephemeral); implies --monitor on")
     args = parser.parse_args(argv)
     return run(args.input, args.output_dir, args.shards,
-               args.entity_keys, telemetry_mode=args.telemetry)
+               args.entity_keys, telemetry_mode=args.telemetry,
+               monitor=args.monitor, status_port=args.status_port)
 
 
 if __name__ == "__main__":

@@ -50,7 +50,7 @@ from photon_ml_tpu.io.index_map import load_index_maps
 from photon_ml_tpu.io.libsvm import read_libsvm
 from photon_ml_tpu.io.model_io import load_game_model
 from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
-from photon_ml_tpu.utils.run_log import RunLogger
+from photon_ml_tpu.utils.run_log import DEFAULT_FLUSH_EVERY_S, RunLogger
 
 # Chunk size for the resident path's chunk-wise mean application — the
 # device sees [MEAN_CHUNK] slices, never the full margins array.
@@ -131,18 +131,26 @@ def run(config: ScoringConfig, log: RunLogger | None = None) -> dict:
     out_dir = os.path.dirname(os.path.abspath(config.output_path))
     os.makedirs(out_dir, exist_ok=True)
     from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry import monitor as _mon
 
     # Context-managed logger lifecycle + shared telemetry session (see
     # the training driver): spans/heartbeats land in scoring_log.jsonl,
-    # trace.json (telemetry=trace) in telemetry_dir.
+    # trace.json (telemetry=trace) in telemetry_dir.  Cadence flushing
+    # + the live monitor (ISSUE 10): `telemetry watch` follows the
+    # scoring log while the pass runs.
     with (log or RunLogger(os.path.join(out_dir,
                                         "scoring_log.jsonl"),
                            run_info={"driver": "game_scoring",
-                                     "telemetry": config.telemetry})
+                                     "telemetry": config.telemetry},
+                           flush_every_s=DEFAULT_FLUSH_EVERY_S)
           ) as log, \
             telemetry.maybe_session(
                 config.telemetry, config.telemetry_dir or out_dir,
-                run_logger=log):
+                run_logger=log), \
+            _mon.maybe_monitor(
+                config.monitor == "on", run_logger=log,
+                status_port=config.status_port,
+                every_s=config.monitor_every_s):
         return _run(config, log)
 
 
@@ -256,10 +264,26 @@ def main(argv: list[str] | None = None) -> dict:
     parser.add_argument("--telemetry-dir", default=None,
                         help="override config telemetry_dir (default: "
                              "the output file's directory)")
+    parser.add_argument("--monitor", choices=("off", "on"),
+                        default=None,
+                        help="override config monitor: live progress/"
+                             "ETA snapshots + online anomaly alerts; "
+                             "follow with python -m photon_ml_tpu"
+                             ".telemetry watch <scoring_log.jsonl>")
+    parser.add_argument("--monitor-every-s", type=float, default=None,
+                        dest="monitor_every_s",
+                        help="override config monitor_every_s: "
+                             "snapshot/alert cadence in seconds")
+    parser.add_argument("--status-port", type=int, default=None,
+                        dest="status_port",
+                        help="serve GET /status + /metrics from a "
+                             "localhost thread on this port (0 = "
+                             "ephemeral); implies --monitor on")
     args = parser.parse_args(argv)
     config = load_scoring_config(args.config)
     for name in ("score_chunk_rows", "spill_dir", "host_max_resident",
-                 "prefetch_depth", "telemetry", "telemetry_dir"):
+                 "prefetch_depth", "telemetry", "telemetry_dir",
+                 "monitor", "monitor_every_s", "status_port"):
         val = getattr(args, name)
         if val is not None:
             setattr(config, name, val)

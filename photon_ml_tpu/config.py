@@ -71,6 +71,19 @@ def read_env(name: str, default: str | None = None) -> str | None:
     return os.environ.get(name, default)
 
 
+def _validate_monitor(cfg) -> None:
+    """Shared live-monitoring knob validation (ISSUE 10) — both run
+    configs carry the same monitor/monitor_every_s/status_port trio."""
+    if cfg.monitor not in ("off", "on"):
+        raise ValueError("monitor must be off|on")
+    if cfg.monitor_every_s <= 0:
+        raise ValueError("monitor_every_s must be positive")
+    if cfg.status_port is not None and not (
+            0 <= cfg.status_port <= 65535):
+        raise ValueError("status_port must be in [0, 65535] "
+                         "(0 = ephemeral)")
+
+
 class CoordinateKind(str, enum.Enum):
     FIXED_EFFECT = "FIXED_EFFECT"
     RANDOM_EFFECT = "RANDOM_EFFECT"
@@ -318,6 +331,23 @@ class TrainingConfig:
     # `python -m photon_ml_tpu.telemetry report <run_log.jsonl>`.
     telemetry: str = "off"
     telemetry_dir: str | None = None
+    # Live run monitoring (photon_ml_tpu.telemetry.monitor, ISSUE 10):
+    # "on" emits cadence-throttled `progress` events (phase, units
+    # done/total, rolling throughput, ETA) from the CD loop, streaming
+    # solvers, streamed-RE sweeps, and the tuner into the run log, and
+    # evaluates the online anomaly rules (diverging loss, throughput
+    # collapse, retry storms, ...) at the same cadence, emitting
+    # structured `alert` events.  Follow live with
+    # `python -m photon_ml_tpu.telemetry watch <run_log.jsonl>`.
+    # "off" (default) is the no-op singleton: zero events, zero extra
+    # compiles, no status thread.  monitor_every_s is the snapshot
+    # cadence; status_port (0 = ephemeral) additionally serves
+    # GET /status (JSON) and GET /metrics (Prometheus text) from a
+    # localhost stdlib http.server thread — setting it implies
+    # monitor="on".
+    monitor: str = "off"
+    monitor_every_s: float = 2.0
+    status_port: int | None = None
     # Multi-host scale-out (SURVEY §5.8/§7 stage 9): when true, the
     # training driver calls jax.distributed.initialize() before any
     # backend use (coordinator/process env read from the standard JAX
@@ -364,6 +394,7 @@ class TrainingConfig:
             raise ValueError("sparse_layout must be AUTO|GRR|COLMAJOR|ELL")
         if self.telemetry not in ("off", "metrics", "trace"):
             raise ValueError("telemetry must be off|metrics|trace")
+        _validate_monitor(self)
         if self.chunk_layout not in ("AUTO", "GRR", "ELL"):
             raise ValueError("chunk_layout must be AUTO|GRR|ELL")
         if self.host_max_resident < 1:
@@ -468,12 +499,19 @@ class ScoringConfig:
     # | trace; telemetry_dir defaults to the output file's directory.
     telemetry: str = "off"
     telemetry_dir: str | None = None
+    # Live run monitoring (see TrainingConfig.monitor): progress/ETA
+    # snapshots + online alerts from the streaming scorer; status_port
+    # serves /status + /metrics (implies monitor="on").
+    monitor: str = "off"
+    monitor_every_s: float = 2.0
+    status_port: int | None = None
 
     def validate(self) -> None:
         if self.score_chunk_rows is not None and self.score_chunk_rows <= 0:
             raise ValueError("score_chunk_rows must be positive")
         if self.telemetry not in ("off", "metrics", "trace"):
             raise ValueError("telemetry must be off|metrics|trace")
+        _validate_monitor(self)
         if self.host_max_resident < 1:
             raise ValueError("host_max_resident must be >= 1")
         if self.prefetch_depth < 0:

@@ -69,6 +69,7 @@ from photon_ml_tpu.ops.regularization import (
 )
 from photon_ml_tpu.optim import OptimizationProblem, OptimizerConfig
 from photon_ml_tpu.optim.variance import VarianceComputationType
+from photon_ml_tpu.telemetry import monitor as _mon
 
 logger = logging.getLogger(__name__)
 
@@ -796,6 +797,12 @@ class GameEstimator:
                 with scope, telemetry.span("swept_train", cat="train",
                                            coordinate=name, lanes=L):
                     W, res = coord.train_swept(offsets, reg, warm_start=W)
+                # Live swept-sweep progress (ISSUE 10): the swept grid
+                # bypasses the CD loop, so it reports its own
+                # sweep-level trajectory for watch/ETA.
+                _mon.progress("swept", i + 1, cfg.n_iterations,
+                              unit="sweeps", coordinate=name, lanes=L,
+                              lanes_done=int(jnp.sum(res.converged)))
                 if validate:
                     with telemetry.span("swept_validation", cat="train",
                                         coordinate=name, lanes=L):
@@ -1035,6 +1042,10 @@ class GameEstimator:
                 self.config.telemetry,
                 self.config.telemetry_dir or self.config.output_dir,
                 run_logger=run_logger), \
+                _mon.maybe_monitor(
+                    self.config.monitor == "on", run_logger=run_logger,
+                    status_port=self.config.status_port,
+                    every_s=self.config.monitor_every_s), \
                 telemetry.span("estimator_fit", cat="phase"):
             prep = self._prepare(train)
             # Device-memory data point right after dataset placement
@@ -1079,6 +1090,10 @@ class GameEstimator:
             stack.enter_context(telemetry.maybe_session(
                 cfg.telemetry, cfg.telemetry_dir or cfg.output_dir,
                 run_logger=run_logger))
+            stack.enter_context(_mon.maybe_monitor(
+                cfg.monitor == "on", run_logger=run_logger,
+                status_port=cfg.status_port,
+                every_s=cfg.monitor_every_s))
             stack.enter_context(telemetry.span("fit_tuned", cat="phase"))
             return self._fit_tuned_inner(train, validation, run_logger,
                                          ev, tuning)

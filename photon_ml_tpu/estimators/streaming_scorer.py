@@ -56,6 +56,7 @@ import numpy as np
 
 from photon_ml_tpu import telemetry
 from photon_ml_tpu.reliability import faults as _faults
+from photon_ml_tpu.telemetry import monitor as _mon
 from photon_ml_tpu.data.sparse_rows import SparseRows
 from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.models.game import (
@@ -539,6 +540,10 @@ class StreamingGameScorer:
                         except AttributeError:  # photon-lint: disable=swallowed-exception (backends without async D2H; drain copies synchronously)
                             pass
                     pending.append((i, m, p))
+                    # Live scoring progress in ROWS (ISSUE 10): the
+                    # monitor's rolling rate is then rows/s directly.
+                    _mon.progress("score", min((i + 1) * R, n), n,
+                                  unit="rows")
                     if len(pending) > _INFLIGHT:
                         drain(pending.pop(0))
                 for item in pending:

@@ -35,6 +35,7 @@ import numpy as np
 from photon_ml_tpu import telemetry
 from photon_ml_tpu.reliability import checkpoint as _ckpt
 from photon_ml_tpu.telemetry import convergence as _conv
+from photon_ml_tpu.telemetry import monitor as _mon
 from photon_ml_tpu.game.coordinates import Coordinate
 
 logger = logging.getLogger(__name__)
@@ -339,6 +340,7 @@ def run_coordinate_descent(
                 coordinates, update_sequence, locked_coordinates, coefs,
                 scores, it, start_iteration, start_pos, checkpointer,
                 run_logger, prev_values, total, _extra, _re_states,
+                n_iterations,
                 seed_diag=(partial_diag if it == start_iteration
                            else None))
             # Normalized to the serialized (plain-dict) diagnostic form
@@ -379,7 +381,7 @@ def run_coordinate_descent(
 def _run_sweep(coordinates, update_sequence, locked_coordinates, coefs,
                scores, it, start_iteration, start_pos, checkpointer,
                run_logger, prev_values, total, extra_fn, re_states_fn,
-               seed_diag=None):
+               n_iterations, seed_diag=None):
     """One CD sweep over the update sequence (split out so the resume
     position logic stays readable).  Mutates ``coefs``/``scores``/
     ``prev_values`` in place; returns (total, iteration diagnostics).
@@ -438,6 +440,13 @@ def _run_sweep(coordinates, update_sequence, locked_coordinates, coefs,
         newly_retired = coord.retire_converged()
         if newly_retired:
             telemetry.count("cd.entities_retired", newly_retired)
+        # Live CD progress (ISSUE 10): coordinate updates completed
+        # against the whole descent's plan — the top-level ETA the
+        # watch view leads with.
+        _mon.progress("cd", it * len(update_sequence) + pos + 1,
+                      n_iterations * len(update_sequence),
+                      unit="updates", iteration=it + 1,
+                      coordinate=name)
         extra = ({} if newly_retired is None
                  else {"entities_newly_retired": newly_retired})
         telemetry.count("cd.coordinate_updates")

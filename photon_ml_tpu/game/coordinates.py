@@ -46,6 +46,7 @@ import numpy as np
 from photon_ml_tpu import telemetry
 from photon_ml_tpu.telemetry import convergence as _conv
 from photon_ml_tpu.telemetry import device as _device
+from photon_ml_tpu.telemetry import monitor as _mon
 from photon_ml_tpu.data.batch import Batch, DenseBatch
 from photon_ml_tpu.game.dataset import (
     EntityGrouping,
@@ -1008,7 +1009,7 @@ class StreamedRandomEffectCoordinate(Coordinate):
         # overlap-efficiency derivation divides consumer wait by.
         with telemetry.span("re_sweep", cat="solver",
                             coordinate=self.name, chunks=len(specs)):
-            for _, item in self._stream(specs, off):
+            for ci, (_, item) in enumerate(self._stream(specs, off)):
                 dev, b, ents, ex, rows, cols = item
                 with telemetry.span("chunk_compute", cat="device",
                                     bucket=b):
@@ -1034,6 +1035,10 @@ class StreamedRandomEffectCoordinate(Coordinate):
                         # are ever in flight.
                         harvest(*pending)
                 pending = (out, b, ents, ex, rows, cols)
+                # Live entity-chunk progress (ISSUE 10): within-sweep
+                # ETA from the observed chunk rate; no-op when off.
+                _mon.progress(f"re.{self.name}", ci + 1, len(specs),
+                              unit="chunks")
             if pending is not None:
                 harvest(*pending)
         telemetry.count("re.sweeps")

@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 
+from photon_ml_tpu.telemetry import monitor as _mon
 from photon_ml_tpu.hyperparameter.search import (
     GaussianProcessSearch,
     RandomSearch,
@@ -67,6 +68,10 @@ class HyperparameterTuner:
             if run_logger is not None:
                 run_logger.event("tuning_trial", trial=t, config=config,
                                  metric=float(metric))
+            # Live tuning progress (ISSUE 10): trials done against the
+            # budget, ETA from the observed trial rate.
+            _mon.progress("tuner", len(trials), n_trials, unit="trials",
+                          metric=float(metric))
         return trials
 
     def run_batched(self, evaluate_batch_fn, n_trials: int,
@@ -130,6 +135,7 @@ class HyperparameterTuner:
                     run_logger.event(
                         "tuning_trial", trial=len(trials) - 1,
                         config=config, metric=float(metric))
+            _mon.progress("tuner", len(trials), n_trials, unit="trials")
         return trials
 
     def best(self, trials: list[TrialResult]) -> TrialResult:

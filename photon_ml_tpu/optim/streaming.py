@@ -49,6 +49,7 @@ from photon_ml_tpu.reliability import checkpoint as _ckpt
 from photon_ml_tpu.reliability import faults as _faults
 from photon_ml_tpu.telemetry import convergence as _conv
 from photon_ml_tpu.telemetry import device as _device
+from photon_ml_tpu.telemetry import monitor as _mon
 from photon_ml_tpu.data.chunked_batch import ChunkedBatch
 from photon_ml_tpu.ops.objective import (
     GLMObjective,
@@ -601,7 +602,7 @@ class ChunkedGLMObjective:
         acc = None
         with telemetry.span("sweep", cat="solver",
                             chunks=self.batch.n_chunks):
-            for cur in self._chunk_stream():
+            for ci, cur in enumerate(self._chunk_stream()):
                 # The span covers the backpressure fence too: that wait
                 # IS the previous chunk's device compute retiring.
                 t0 = time.perf_counter() if timed else None
@@ -609,6 +610,12 @@ class ChunkedGLMObjective:
                     if bounded and acc is not None:
                         jax.block_until_ready(acc)
                     out = per_chunk(cur)
+                # Live chunk progress (ISSUE 10): the monitor derives
+                # rolling chunk throughput + a within-sweep ETA; a
+                # no-op global read when monitoring is off, throttled
+                # to its wall-clock cadence when on.
+                _mon.progress("train.sweep", ci + 1,
+                              self.batch.n_chunks, unit="chunks")
                 newly_captured = False
                 if acc is None and cost is not None:
                     name, fn, mk_args = cost
@@ -766,6 +773,8 @@ class ChunkedGLMObjective:
                     pass
                 lo, hi = self.batch.chunk_slice(i)
                 pending.append((m, hi - lo))
+                _mon.progress("train.pass", i + 1,
+                              self.batch.n_chunks, unit="chunks")
             if not pending:
                 return np.zeros(0, np.float32)
             # device_get, not np.asarray: the harvest is a PLANNED
@@ -1040,6 +1049,11 @@ def streaming_lbfgs_solve(
                         float(g_norm),
                         step_size=(alpha_used if ls_ok else 0.0),
                         ls_trials=trials)
+        # Live solver progress (ISSUE 10): iteration count against the
+        # budget plus the loss the online divergence rules watch.
+        _mon.progress("solver" + (f".{label}" if label else ""),
+                      it, config.max_iters, unit="iters",
+                      loss=float(f_new), grad_norm=float(g_norm))
         logger.info("streaming lbfgs iter %d: f=%.6f |pg|=%.3e%s", it,
                     float(f_new), float(g_norm),
                     " (stalled)" if stalled else "")
@@ -1295,6 +1309,10 @@ def streaming_lbfgs_solve_swept(
                         ls_trials=trials,
                         lanes_active=int(jnp.sum(active)),
                         lanes_done=int(jnp.sum(done)))
+        _mon.progress("solver" + (f".{label}" if label else ""),
+                      it, config.max_iters, unit="iters",
+                      loss=float(jnp.min(F)),
+                      lanes_done=int(jnp.sum(done)), lanes=L)
         logger.info(
             "streaming swept lbfgs iter %d: %d/%d lanes done, "
             "f_best=%.6f", it, int(jnp.sum(done)), L,

@@ -44,6 +44,7 @@ the (internally locked) ``RunLogger``.
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import logging
 import os
@@ -63,6 +64,13 @@ PASS_SPANS = ("sweep", "per_example_pass", "score_pass", "re_sweep")
 # the reservoir decimates to every-other sample and doubles its stride
 # (deterministic — no RNG in the telemetry path).
 _RESERVOIR_CAP = 1024
+
+# Counter rate() support (ISSUE 10): per-counter (ts, cumulative)
+# samples older than the horizon are dropped at cap-time cleanup — the
+# monitor's alert rules only ever ask about trailing windows of tens
+# of seconds.
+_RATE_HORIZON_S = 300.0
+_RATE_SERIES_CAP = 4096
 
 
 class _NullSpan:
@@ -298,6 +306,7 @@ class Telemetry:
         self._lock = threading.Lock()
         self._local = threading.local()
         self._counters: dict = {}
+        self._counter_series: dict = {}   # name -> [(ts, cumulative)]
         self._gauges: dict = {}
         self._hists: dict = {}
         self._span_stats: dict = {}
@@ -395,8 +404,80 @@ class Telemetry:
     # -- metrics ------------------------------------------------------------
 
     def count(self, name: str, n=1) -> None:
+        now = self.now()
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + n
+            v = self._counters.get(name, 0) + n
+            self._counters[name] = v
+            # Rolling (ts, cumulative) series behind rate().  Per
+            # increment the series pays ONE append; pruning is deferred
+            # to the cap — one batched front-drop of horizon-stale
+            # entries, then every-other decimation (keeping the
+            # just-appended newest sample) — so a hot per-chunk counter
+            # amortizes the cleanup to O(1) instead of a per-call
+            # memmove.  Stale front entries before a cleanup only cost
+            # memory (bounded by the cap): rate() walks from the back
+            # and never reads past its window.
+            s = self._counter_series.get(name)
+            if s is None:
+                s = self._counter_series[name] = []
+            s.append((now, v))
+            if len(s) >= _RATE_SERIES_CAP:
+                cutoff = now - _RATE_HORIZON_S
+                k = min(bisect.bisect_left(s, (cutoff,)), len(s) - 2)
+                if k > 0:
+                    del s[:k]
+                if len(s) >= _RATE_SERIES_CAP:
+                    del s[1::2]
+
+    def counter(self, name: str, default=0):
+        """Current cumulative value of counter ``name``."""
+        with self._lock:
+            return self._counters.get(name, default)
+
+    def gauge_value(self, name: str) -> dict | None:
+        """Snapshot of gauge ``name`` ({last, min, max}) or None."""
+        with self._lock:
+            g = self._gauges.get(name)
+            return None if g is None else dict(g)
+
+    def rate(self, name: str, window_s: float = 30.0,
+             now: float | None = None) -> float | None:
+        """Rolling-window rate of counter ``name`` in units/second
+        (ISSUE 10): the live-monitoring tier needs throughput-per-
+        second, not lifetime totals — a run that was fast an hour ago
+        and is stalled NOW has a healthy lifetime average.
+
+        The rate is ``Δvalue / Δt`` between the newest sample and the
+        oldest sample inside the trailing ``window_s`` (anchored at
+        ``now`` on the session clock when given, else at the newest
+        sample).  Error contract (pinned by the bounded-error unit
+        test): samples are exact (every ``count()`` records one), so
+        within the horizon the only approximation is decimation under
+        the series cap — the retained every-other subsample still
+        brackets the window to within one inter-sample gap, i.e. the
+        reported rate is the exact mean rate over an interval that
+        differs from the requested window by at most two sample
+        spacings.  None when fewer than two samples exist (or the
+        counter is unknown)."""
+        if window_s <= 0:
+            raise ValueError(f"window_s must be positive, got {window_s!r}")
+        with self._lock:
+            s = self._counter_series.get(name)
+            if not s or len(s) < 2:
+                return None
+            anchor = s[-1][0] if now is None else float(now)
+            cutoff = anchor - window_s
+            base = None
+            for ts, v in reversed(s):
+                if ts < cutoff:
+                    break
+                base = (ts, v)
+            if base is None or base[0] >= s[-1][0]:
+                base = s[-2]
+            dt = anchor - base[0]
+            if dt <= 0:
+                return None
+            return (s[-1][1] - base[1]) / dt
 
     def gauge(self, name: str, value) -> None:
         value = float(value)

@@ -1,4 +1,5 @@
-"""Telemetry CLI: ``python -m photon_ml_tpu.telemetry <report|history>``.
+"""Telemetry CLI: ``python -m photon_ml_tpu.telemetry
+<report|history|watch>``.
 
 ``report <log>`` prints the per-phase / stage-span / overlap /
 convergence / device / reconciliation report for a run's
@@ -11,9 +12,15 @@ check fails.
 ``bench.py --history-dir`` envelopes) into per-section metric
 trajectories and gates them against a rolling baseline (see
 ``telemetry.history``); exit code 1 on any regression or on any round
-with a nonzero rc.
+with a nonzero rc not waived via ``--known-bad``.
 
-Both subcommands print one machine-parseable JSON object as the last
+``watch <log>`` follows a LIVE, still-being-written run log (ISSUE
+10): a refreshing status view — phase, per-stage progress/ETA, loss
+trajectory, reliability counters, active alerts — that exits when the
+run logs ``done`` (or ``--once`` for a single snapshot); see
+``telemetry.watch``.
+
+All subcommands print one machine-parseable JSON object as the last
 stdout line (the repo's CLI contract).
 """
 
@@ -22,9 +29,11 @@ from __future__ import annotations
 import argparse
 import sys
 
+from photon_ml_tpu.telemetry import watch as watch_mod
 from photon_ml_tpu.telemetry.history import (
     DEFAULT_TOLERANCE,
     DEFAULT_WINDOW,
+    parse_known_bad,
     run_history,
 )
 from photon_ml_tpu.telemetry.report import report
@@ -56,10 +65,43 @@ def main(argv=None) -> int:
     hp.add_argument("--window", type=int, default=DEFAULT_WINDOW,
                     help="rolling-baseline width in preceding rounds "
                          f"(default {DEFAULT_WINDOW})")
+    hp.add_argument("--known-bad", action="append", default=[],
+                    metavar="ROUND=REASON",
+                    help="waive an acknowledged bad round (e.g. "
+                         "BENCH_r05.json=rc-124 budget timeout, see "
+                         "PERF.md): its rc/regressions stop failing "
+                         "the gate; the reason is REQUIRED and echoed "
+                         "in the markdown output. Repeatable.")
+    wp = sub.add_parser(
+        "watch", help="follow a live run_log.jsonl: phase, per-stage "
+                      "progress/ETA, loss trajectory, alerts; exits "
+                      "when the run logs its done event")
+    wp.add_argument("log", help="path to a (possibly still-being-"
+                                "written) run_log.jsonl")
+    wp.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (scripting mode; "
+                         "the JSON last line is the snapshot)")
+    wp.add_argument("--interval", type=float,
+                    default=watch_mod.DEFAULT_INTERVAL_S,
+                    help="refresh cadence in seconds (default "
+                         f"{watch_mod.DEFAULT_INTERVAL_S})")
+    wp.add_argument("--max-wait-s", type=float, default=None,
+                    help="give up following after this many seconds "
+                         "without a done event (a killed run's log "
+                         "stops growing but never finishes)")
     args = p.parse_args(argv)
+    if args.cmd == "watch":
+        snap = watch_mod.watch(args.log, once=args.once,
+                               interval_s=args.interval,
+                               max_wait_s=args.max_wait_s)
+        return 0 if not snap["thread_exceptions"] else 1
     if args.cmd == "history":
+        try:
+            waivers = parse_known_bad(args.known_bad)
+        except ValueError as e:
+            p.error(str(e))
         result = run_history(args.paths, tolerance=args.tolerance,
-                             window=args.window)
+                             window=args.window, known_bad=waivers)
         return 0 if result["ok"] else 1
     result = report(args.log, threshold=args.threshold)
     return 0 if result["ok"] else 1

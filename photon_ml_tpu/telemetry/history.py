@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 
 # A 20% worsening must gate (the bench contract test injects exactly
 # that), so the default sits below it; host-jitter on the 2-core bench
@@ -131,21 +132,42 @@ def trajectory(rounds: list[dict]) -> dict:
     return out
 
 
-def _median(xs: list[float]) -> float:
-    s = sorted(xs)
-    n = len(s)
-    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+def parse_known_bad(specs: list[str]) -> dict[str, str]:
+    """``--known-bad ROUND=REASON`` flags → ``{round name: reason}``.
+
+    The reason is REQUIRED (ISSUE 10): a waiver with no recorded "why"
+    is how a real regression gets rubber-stamped next quarter — the
+    gate echoes the reason in its markdown so the acknowledgment
+    travels with every trajectory report."""
+    out: dict[str, str] = {}
+    for spec in specs:
+        round_name, sep, reason = spec.partition("=")
+        if not sep or not reason.strip() or not round_name.strip():
+            raise ValueError(
+                f"--known-bad needs ROUND=REASON (a reason is "
+                f"required), got {spec!r}")
+        out[round_name.strip()] = reason.strip()
+    return out
 
 
 def detect(rounds: list[dict], tolerance: float = DEFAULT_TOLERANCE,
-           window: int = DEFAULT_WINDOW) -> dict:
+           window: int = DEFAULT_WINDOW,
+           known_bad: dict[str, str] | None = None) -> dict:
     """Regressions + failed rounds over the trajectory.
 
     A value regresses when it is worse than the rolling baseline (the
     median of up to ``window`` PRECEDING non-null values) by more than
     ``tolerance`` relative; the first valid value of a metric is its
     own baseline (never flagged).  Baselines at or below zero are
-    skipped — a relative tolerance has no meaning there."""
+    skipped — a relative tolerance has no meaning there.
+
+    ``known_bad`` (ISSUE 10, ``--known-bad ROUND=REASON``): rounds
+    whose failure is already acknowledged (BENCH_r05's rc-124 budget
+    timeout is the resident case) move from ``failed_rounds``/
+    ``regressions`` to ``waived`` and stop failing the gate; the
+    waived round's values STILL feed later baselines exactly as
+    before — the waiver silences the verdict, not the data."""
+    known_bad = known_bad or {}
     traj = trajectory(rounds)
     regressions = []
     for key, ent in traj.items():
@@ -156,7 +178,7 @@ def detect(rounds: list[dict], tolerance: float = DEFAULT_TOLERANCE,
             if v is None:
                 continue
             if seen:
-                base = _median(seen[-window:])
+                base = statistics.median(seen[-window:])
                 if base > 0:
                     change = (v - base) / base
                     if (-change if higher else change) > tolerance:
@@ -172,12 +194,23 @@ def detect(rounds: list[dict], tolerance: float = DEFAULT_TOLERANCE,
     failed = [{"round": r["name"], "rc": r["rc"],
                **({"error": r["error"]} if r.get("error") else {})}
               for r in rounds if r["rc"] not in (0,)]
+    waived = ([{**f, "reason": known_bad[f["round"]]}
+               for f in failed if f["round"] in known_bad]
+              + [{**reg, "reason": known_bad[reg["round"]]}
+                 for reg in regressions if reg["round"] in known_bad])
+    failed = [f for f in failed if f["round"] not in known_bad]
+    regressions = [reg for reg in regressions
+                   if reg["round"] not in known_bad]
+    round_names = {r["name"] for r in rounds}
+    unknown_waivers = sorted(set(known_bad) - round_names)
     return {
         "ok": not regressions and not failed,
         "rounds": [r["name"] for r in rounds],
         "trajectory": traj,
         "regressions": regressions,
         "failed_rounds": failed,
+        "waived": waived,
+        "unknown_waivers": unknown_waivers,
         "tolerance": tolerance,
         "window": window,
     }
@@ -204,6 +237,15 @@ def render_markdown(result: dict, out) -> None:
     for fr in result["failed_rounds"]:
         w(f"**FAILED ROUND** {fr['round']}: rc={fr['rc']}"
           + (f" ({fr['error']})" if fr.get("error") else ""))
+    for wv in result.get("waived", []):
+        what = (f"rc={wv['rc']}" if "rc" in wv
+                else f"{wv['metric']}: {wv['value']:g} "
+                     f"({wv['change']:+.1%})")
+        w(f"**WAIVED** {wv['round']} ({what}) — known bad: "
+          f"{wv['reason']}")
+    for name in result.get("unknown_waivers", []):
+        w(f"**UNKNOWN WAIVER** --known-bad {name} matches no loaded "
+          "round (typo, or the round was removed)")
     for reg in result["regressions"]:
         w(f"**REGRESSION** {reg['round']} {reg['metric']}: "
           f"{reg['value']:g} vs baseline {reg['baseline']:g} "
@@ -215,14 +257,16 @@ def render_markdown(result: dict, out) -> None:
 
 
 def run_history(paths: list[str], tolerance: float = DEFAULT_TOLERANCE,
-                window: int = DEFAULT_WINDOW, out=None) -> dict:
+                window: int = DEFAULT_WINDOW, out=None,
+                known_bad: dict[str, str] | None = None) -> dict:
     """Load → detect → print (markdown + JSON last line); returns the
     result dict (``ok`` drives the exit code)."""
     import sys
 
     out = out or sys.stdout
     rounds = load_rounds(paths)
-    result = detect(rounds, tolerance=tolerance, window=window)
+    result = detect(rounds, tolerance=tolerance, window=window,
+                    known_bad=known_bad)
     render_markdown(result, out)
     print(json.dumps(result), file=out)
     return result

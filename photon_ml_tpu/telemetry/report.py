@@ -380,6 +380,16 @@ def report(path: str, threshold: float = 0.9, out=None) -> dict:
           "run segment died mid-write).")
         w()
 
+    alerts = [{k: v for k, v in ev.items() if k != "event"}
+              for ev in events if ev.get("event") == "alert"]
+    if alerts:
+        w("Alerts (live monitor, ISSUE 10):")
+        for a in alerts:
+            stage = f" ({a['stage']})" if a.get("stage") else ""
+            w(f"  [{a.get('severity', 'warn')}] {a.get('rule', '?')}"
+              f"{stage} at t={a.get('t', '?')}: {a.get('message', '')}")
+        w()
+
     beats: dict = {}
     deaths = []
     for ev in events:
@@ -436,6 +446,7 @@ def report(path: str, threshold: float = 0.9, out=None) -> dict:
         "reconciliation_thread": recon["thread"],
         "reconciliation_threads": recon["threads"],
         "counters": counters,
+        "alerts": alerts,
         "heartbeats": beats,
         "thread_exceptions": len(deaths),
         "mode": (summary or {}).get("mode"),

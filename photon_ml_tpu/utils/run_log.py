@@ -38,6 +38,21 @@ logger = logging.getLogger("photon_ml_tpu")
 # tolerate ABSENCE entirely (pre-ISSUE-8 logs have no header).
 RUN_LOG_SCHEMA = 1
 
+# Cadence-flush default for the drivers (ISSUE 10): a live consumer
+# (`telemetry watch`, crash forensics) sees events at most this stale,
+# while hot instrumented paths stop paying one flush syscall per line.
+DEFAULT_FLUSH_EVERY_S = 2.0
+
+# Events a live consumer (or a post-mortem) must never find missing:
+# flushed immediately regardless of the cadence.  ``progress`` is
+# already cadence-throttled at the monitor, so flushing each one costs
+# nothing extra and keeps `watch` within one snapshot cadence of truth.
+_FLUSH_NOW = frozenset({
+    "run_header", "alert", "thread_exception", "progress",
+    "phase_start", "phase_end", "telemetry_summary", "monitor_summary",
+    "status_server", "done",
+})
+
 
 def _runtime_info() -> dict:
     """Best-effort runtime facts for the header: jax version/platform
@@ -73,7 +88,8 @@ class RunLogger:
 
     def __init__(self, path: str | None = None, mode: str = "w",
                  run_info: dict | None = None,
-                 header: bool | None = None):
+                 header: bool | None = None,
+                 flush_every_s: float | None = None):
         """``mode="w"`` (default) makes each run's log self-contained —
         rerunning into the same output dir must not interleave events
         from prior runs; pass ``"a"`` to accumulate deliberately.
@@ -87,10 +103,25 @@ class RunLogger:
         one ``run_header`` per process segment and ``telemetry
         report`` can reconcile the segments separately (their clocks
         restart at each header).  ``report``/``history`` consume it and
-        tolerate its absence in pre-existing logs."""
+        tolerate its absence in pre-existing logs.
+
+        ``flush_every_s`` (ISSUE 10): None (default) flushes after
+        EVERY event — maximal freshness for library/test use; a
+        positive cadence batches flushes so a hot instrumented path
+        pays one syscall per cadence window instead of per line, while
+        ``_FLUSH_NOW`` event kinds (headers, alerts, progress
+        snapshots, thread deaths, phase boundaries) still flush
+        immediately — a live ``telemetry watch`` and a kill-forensic
+        read both stay current.  Drivers pass
+        ``DEFAULT_FLUSH_EVERY_S``."""
         self.path = path
         self._t0 = time.monotonic()
         self._f = None
+        if flush_every_s is not None and flush_every_s < 0:
+            raise ValueError(
+                f"flush_every_s must be >= 0, got {flush_every_s!r}")
+        self._flush_every_s = flush_every_s
+        self._last_flush = time.monotonic()
         self.run_info = dict(run_info or {})
         # Events arrive from pipeline threads too (telemetry heartbeats,
         # span merges): one lock keeps lines whole and the handle state
@@ -134,8 +165,21 @@ class RunLogger:
         with self._lock:
             if self._f is not None:
                 self._f.write(json.dumps(rec) + "\n")
-                self._f.flush()
+                now_m = time.monotonic()
+                if (not self._flush_every_s or kind in _FLUSH_NOW
+                        or now_m - self._last_flush
+                        >= self._flush_every_s):
+                    self._f.flush()
+                    self._last_flush = now_m
         logger.info("%s %s", kind, fields)
+
+    def flush(self) -> None:
+        """Force buffered events to disk (the cadence path flushes on
+        its own; this is for callers handing the file to a reader)."""
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+                self._last_flush = time.monotonic()
 
     @contextlib.contextmanager
     def timed(self, phase: str, profile_dir: str | None = None, **fields):
@@ -151,8 +195,12 @@ class RunLogger:
         tier's stage spans.
         """
         from photon_ml_tpu import telemetry
+        from photon_ml_tpu.telemetry import monitor as _monitor
 
         self.event("phase_start", phase=phase, **fields)
+        # The live monitor's /status "phase" field tracks the innermost
+        # open driver phase (no-op when monitoring is off, ISSUE 10).
+        _monitor.phase_begin(phase)
         start = time.monotonic()
         prof = contextlib.nullcontext()
         if profile_dir:
@@ -163,6 +211,7 @@ class RunLogger:
             with telemetry.span(phase, cat="phase"), prof:
                 yield
         finally:
+            _monitor.phase_end(phase)
             self.event(
                 "phase_end", phase=phase,
                 duration_s=round(time.monotonic() - start, 6),

@@ -115,6 +115,15 @@ def test_bench_stream_section_contract(tmp_path):
     # derivation is defined and the pinned counters are live.
     for arm in ("spilled", "resident"):
         assert "telemetry" in s[arm], sorted(s[arm])
+    # ISSUE 10: monitoring OFF stays the default — no monitor session
+    # (no `progress` block in the arm record, no status thread probe)
+    # and zero `progress` events counted over the timed sweeps.
+    assert s["monitor"] is False
+    for arm in ("spilled", "resident"):
+        assert "progress" not in s[arm], sorted(s[arm])
+        assert "status_ok" not in s[arm], sorted(s[arm])
+        assert s[arm]["telemetry"]["progress_events"] == 0
+        assert s[arm]["telemetry"]["alerts"] == 0
     tel = s["spilled"]["telemetry"]
     assert tel["sweeps"] == s["sweeps_timed"]
     assert tel["overlap_efficiency"] is not None
@@ -145,6 +154,35 @@ def test_bench_stream_section_contract(tmp_path):
     assert s["pass_time_ratio"] is not None
     # Satellite: every section records the RSS high-water trajectory.
     assert rec["peak_rss_mb"]["stream"] > 0
+
+
+@pytest.mark.fast
+def test_bench_stream_arm_monitor_contract(tmp_path):
+    """A monitoring-ON stream arm (ISSUE 10): one `--stream-arm
+    spilled --monitor --guards` subprocess embeds a `progress` block
+    (stage snapshots from the live monitor), proves its ephemeral
+    /status endpoint answered from inside the measured process, and
+    STILL compiles nothing over the timed sweeps — the monitor never
+    touches jax."""
+    proc = _run_bench(tmp_path, "--stream-arm", "spilled",
+                      "--monitor", "--guards", *_TINY)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert rec["arm"] == "spilled"
+    prog = rec["progress"]
+    # The chunk loop reported: the sweep stage has done == total and a
+    # rolling rate, and at least one snapshot event was emitted.
+    assert prog["snapshots"] >= 1
+    sweep = prog["stages"]["train.sweep"]
+    assert sweep["done"] == sweep["total"] > 0
+    assert sweep["unit"] == "chunks"
+    # The status endpoint answered a live GET /status with stages.
+    assert rec["status_ok"] is True
+    # Monitoring must not break the steady-state compile contract.
+    assert rec["guards"]["sweep_compiles"] == 0, rec["guards"]
+    # The registry counted exactly the emitted snapshots.
+    assert rec["telemetry"]["progress_events"] == prog["snapshots"]
 
 
 @pytest.mark.slow   # 10s+ in tests/tier1_durations.json
