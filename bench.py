@@ -77,7 +77,7 @@ V5E_PEAK_GBPS = PLATFORM_PEAK_GBPS["tpu"][0]
 
 DEFAULT_SECTIONS = ("etl", "cached", "grr", "segment_sum", "colmajor")
 ALL_SECTIONS = DEFAULT_SECTIONS + ("powerlaw", "chunked", "sweep",
-                                   "stream", "score", "re")
+                                   "stream", "score", "re", "cd_fused")
 DEFAULT_BUDGET_S = 840.0
 DEFAULT_N, DEFAULT_D, DEFAULT_K = 1_000_000, 100_000, 30
 
@@ -118,6 +118,22 @@ RE_TOL = 1e-4           # solver tolerance = retirement threshold
 SWEEP_LANES = 6
 SWEEP_MAX_ITERS = 12
 
+# Fused-CD section shape (ISSUE 11): the SAME fixed-effect + random-
+# effect workload trained twice — per-coordinate (C streamed passes per
+# CD cycle: solver iterations × line-search trials per coordinate) and
+# fused (ONE pass per cycle, Jacobi solves).  The fused arm runs more
+# (cheap) cycles — its per-cycle step is one damped Newton update, not
+# a full inner solve — so the section's claims are pass COUNT per
+# cycle, per-pass time, peak RSS, and cross-arm coefficient parity at
+# convergence, not equal-cycle wall clock.
+CDF_CHUNKS = 8
+CDF_WINDOW = 2
+CDF_DEPTH = 2
+CDF_FUSED_CYCLES = 40
+CDF_LEGACY_ITERS = 4
+CDF_LEGACY_MAX_ITERS = 15
+CDF_D_RE = 4
+
 # Per-section wall-clock estimates at the FULL bench shape on the
 # measured host (BENCH_r05 tail: etl 123 s, grr measure 346 s, colmajor
 # 305 s, segment_sum 35 s; powerlaw/chunked from the r05 PERF record),
@@ -144,6 +160,9 @@ SECTION_EST_S = {
     # Two subprocess arms × (entity-chunk ETL + RE_SWEEPS vmapped
     # bucket solves over the full dataset).
     "re": 420.0,
+    # Two subprocess arms × (chunk ETL + a warm-up fit + the measured
+    # fit: CDF_FUSED_CYCLES+1 passes fused, ~C×iters passes legacy).
+    "cd_fused": 480.0,
 }
 
 
@@ -1514,6 +1533,221 @@ def section_re(ctx: BenchContext) -> None:
           f"coef parity {coef_parity:.2e}", file=sys.stderr)
 
 
+def _make_cd_fused_workload(n: int, d: int, k: int, seed: int = 11):
+    """Synthetic GAME workload for the fused-CD section: a sparse
+    fixed-effect shard (the chunked master grid) + a dense random
+    effect with skewed entity sizes (several buckets, like the re
+    section), labels from both planes so neither coordinate is
+    decorative."""
+    from photon_ml_tpu.game.dataset import GameDataset
+
+    rng = np.random.default_rng(seed)
+    cols, vals, _ = _make_ell(n, d, k, seed=seed)
+    e_small = max(8, n // 256)
+    e_big = max(2, e_small // 16)
+    n_small = (3 * n) // 4
+    ids = np.concatenate([
+        rng.integers(0, e_small, n_small),
+        rng.integers(e_small, e_small + e_big, n - n_small),
+    ]).astype(np.int64)
+    E = e_small + e_big
+    x_re = rng.normal(0, 1, (n, CDF_D_RE)).astype(np.float32)
+    w_fe = rng.normal(0, 1, d).astype(np.float32)
+    w_re = rng.normal(0, 0.5, (E, CDF_D_RE)).astype(np.float32)
+    margins = (np.einsum("nk,nk->n", vals, w_fe[cols])
+               + np.einsum("np,np->n", x_re, w_re[ids]))
+    labels = (rng.uniform(size=n)
+              < 1.0 / (1.0 + np.exp(-margins))).astype(np.float32)
+    rows = [(cols[i], vals[i]) for i in range(n)]
+    return GameDataset(labels=labels,
+                       features={"fe": rows, "re": x_re},
+                       entity_ids={"u": ids},
+                       feature_dims={"fe": d})
+
+
+def cd_fused_arm_main(args) -> int:
+    """One arm of the ``cd_fused`` section in its OWN process (per-arm
+    ``ru_maxrss`` honesty): the same chunked FE + dense-RE workload
+    trained with ``cd_fused`` on (``fused``) or off (``percoord``).
+    A 1-cycle warm-up fit pays the XLA compiles and spills the chunk
+    stores; the MEASURED fit then runs with a warm everything — its
+    ``compiles`` count is the zero-new-compiles-after-warmup claim.
+    Emits one JSON line; saves final coefficients for the parent's
+    cross-arm parity check."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.config import (
+        CoordinateConfig,
+        CoordinateKind,
+        OptimizerSettings,
+        TrainingConfig,
+    )
+    from photon_ml_tpu.estimators.game_estimator import GameEstimator
+    from photon_ml_tpu.models.glm import TaskType
+
+    arm = args.cd_fused_arm
+    n = args.n
+    fused = arm == "fused"
+    ds = _make_cd_fused_workload(n, args.d, args.k)
+    chunk_rows = -(-n // CDF_CHUNKS)
+
+    def cfg(iters):
+        return TrainingConfig(
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            coordinates=[
+                CoordinateConfig(
+                    name="global", kind=CoordinateKind.FIXED_EFFECT,
+                    feature_shard="fe",
+                    optimizer=OptimizerSettings(
+                        max_iters=CDF_LEGACY_MAX_ITERS, reg_weight=1.0)),
+                CoordinateConfig(
+                    name="per_u", kind=CoordinateKind.RANDOM_EFFECT,
+                    feature_shard="re", entity_key="u",
+                    optimizer=OptimizerSettings(
+                        max_iters=CDF_LEGACY_MAX_ITERS, reg_weight=2.0)),
+            ],
+            update_sequence=["global", "per_u"], n_iterations=iters,
+            validation_fraction=0.0, validate_per_iteration=False,
+            intercept=False, chunk_rows=chunk_rows, chunk_layout="ELL",
+            cd_fused=fused,
+            spill_dir=os.path.join(args.cache_dir, f"spill_cdf_{arm}"),
+            host_max_resident=CDF_WINDOW, prefetch_depth=CDF_DEPTH)
+
+    base_mb = _current_rss_mb()
+    # Warm-up: compiles + chunk/sidecar spill (content-keyed — the
+    # measured fit reuses the files).  Runs OUTSIDE the telemetry
+    # window and the RSS sampler, the other sections' rule.
+    t0 = time.time()
+    warm_cfg = cfg(1)
+    warm_cfg.validate()
+    GameEstimator(warm_cfg).fit(ds)
+    warmup_s = time.time() - t0
+
+    iters = CDF_FUSED_CYCLES if fused else CDF_LEGACY_ITERS
+    run_cfg = cfg(iters)
+    run_cfg.validate()
+    tel = telemetry.start("metrics")
+    t0 = time.time()
+    with _RssSampler() as rss:
+        fit = GameEstimator(run_cfg).fit(ds)[0]
+    fit_s = time.time() - t0
+    tel_summary = tel.summary()
+    tel.close()
+
+    c = tel_summary.get("counters", {})
+    d_ = tel_summary.get("derived", {})
+    sweeps = c.get("solver.sweeps", 0)
+    cycles = c.get("cd.cycles", 0)
+    pass_total_s = d_.get("pass_span_total_s") or None
+    pass_s = (pass_total_s / sweeps if pass_total_s and sweeps else None)
+    models = fit.model.models
+    np.save(os.path.join(args.cache_dir, f"cdf_fe_{arm}.npy"),
+            np.asarray(models["global"].coefficients.means))
+    np.save(os.path.join(args.cache_dir, f"cdf_re_{arm}.npy"),
+            np.concatenate([np.asarray(b).ravel()
+                            for b in models["per_u"].coefficient_blocks]))
+
+    peak = _peak_rss_mb()
+    rec = {
+        "arm": arm,
+        "warmup_s": round(warmup_s, 1),
+        "fit_s": round(fit_s, 2),
+        "cycles": cycles,
+        "data_passes": sweeps,
+        "passes_per_cycle": (round(sweeps / cycles, 3) if cycles
+                             else None),
+        "pass_s": round(pass_s, 3) if pass_s else None,
+        "rows_per_sec": (round(n * sweeps / pass_total_s, 1)
+                         if pass_total_s else None),
+        "chunk_rows": chunk_rows,
+        "n_chunks": CDF_CHUNKS,
+        "peak_rss_mb": round(peak, 1),
+        "fit_peak_rss_mb": round(rss.peak_mb, 1),
+        "rss_delta_mb": (round(rss.peak_mb - base_mb, 1)
+                         if base_mb is not None else None),
+        "telemetry": _telemetry_block(tel_summary),
+    }
+    print(json.dumps(rec))
+    return 0
+
+
+def section_cd_fused(ctx: BenchContext) -> None:
+    """Fused CD super-sweep vs per-coordinate training (ISSUE 11
+    tentpole measurement): the same workload in two subprocess arms.
+    Claims under test: the fused arm's passes/cycle ≈ 1 (vs ~C ×
+    solver-iterations per cycle legacy), its per-pass time stays within
+    a small factor of the legacy pass (it computes every coordinate's
+    statistics per chunk), zero compiles in the measured (warm) fit,
+    and the two arms' final coefficients agree at convergence."""
+    import shutil
+    import subprocess
+
+    for arm in ("fused", "percoord"):
+        shutil.rmtree(os.path.join(ctx.cache_dir, f"spill_cdf_{arm}"),
+                      ignore_errors=True)   # honest cold spill ETL
+
+    def run_arm(arm: str) -> dict:
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--cd-fused-arm", arm, "--n", str(ctx.n), "--d", str(ctx.d),
+             "--k", str(ctx.k), "--cache-dir", ctx.cache_dir]
+            + (["--no-compile-cache"] if ctx.no_compile_cache else []),
+            capture_output=True, text=True,
+            timeout=max(60.0, ctx.remaining()),
+        )
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            raise RuntimeError(f"cd_fused arm {arm!r} failed "
+                               f"(rc={proc.returncode}): "
+                               f"{proc.stderr[-500:]}")
+        rec = json.loads(
+            [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+        rec["arm_wall_s"] = round(time.time() - t0, 1)
+        return rec
+
+    fused = run_arm("fused")
+    percoord = run_arm("percoord")
+    fe_f = np.load(os.path.join(ctx.cache_dir, "cdf_fe_fused.npy"))
+    fe_p = np.load(os.path.join(ctx.cache_dir, "cdf_fe_percoord.npy"))
+    re_f = np.load(os.path.join(ctx.cache_dir, "cdf_re_fused.npy"))
+    re_p = np.load(os.path.join(ctx.cache_dir, "cdf_re_percoord.npy"))
+    coef_parity = float(max(np.max(np.abs(fe_f - fe_p)),
+                            np.max(np.abs(re_f - re_p))
+                            if len(re_f) else 0.0))
+
+    def ratio(a, b):
+        if a is None or b is None or b == 0:
+            return None
+        return round(a / b, 3)
+
+    ctx.record["cd_fused"] = {
+        "n_chunks": CDF_CHUNKS,
+        "host_max_resident": CDF_WINDOW,
+        "prefetch_depth": CDF_DEPTH,
+        "fused": fused,
+        "percoord": percoord,
+        "passes_per_cycle_fused": fused["passes_per_cycle"],
+        "passes_per_cycle_percoord": percoord["passes_per_cycle"],
+        "pass_count_ratio": ratio(percoord["passes_per_cycle"],
+                                  fused["passes_per_cycle"]),
+        # The fused pass computes every coordinate's statistics, so it
+        # is allowed to cost more than one legacy (FE-only) pass — the
+        # win is needing ~C× fewer of them per cycle.
+        "pass_time_ratio": ratio(fused["pass_s"], percoord["pass_s"]),
+        "coef_parity_max": coef_parity,
+    }
+    s = ctx.record["cd_fused"]
+    print(f"cd_fused: fused {fused['passes_per_cycle']} passes/cycle "
+          f"({fused['pass_s']}s/pass, {fused['cycles']} cycles, peak "
+          f"RSS {fused['peak_rss_mb']} MB, compiles "
+          f"{fused['telemetry']['compiles']}) vs per-coordinate "
+          f"{percoord['passes_per_cycle']} passes/cycle "
+          f"({percoord['pass_s']}s/pass); pass-count ratio "
+          f"{s['pass_count_ratio']}x, pass-time ratio "
+          f"{s['pass_time_ratio']}x, coef parity {coef_parity:.2e}",
+          file=sys.stderr)
+
+
 SECTION_FNS = {
     "etl": section_etl,
     "cached": section_cached,
@@ -1526,6 +1760,7 @@ SECTION_FNS = {
     "stream": section_stream,
     "score": section_score,
     "re": section_re,
+    "cd_fused": section_cd_fused,
 }
 
 
@@ -1634,6 +1869,10 @@ def main(argv: list[str] | None = None) -> int:
                    default=None,
                    help="internal: run ONE arm of the score section "
                         "in this process (per-arm peak-RSS isolation)")
+    p.add_argument("--cd-fused-arm", choices=("fused", "percoord"),
+                   default=None,
+                   help="internal: run ONE cd_fused-section arm in this "
+                        "process and emit its JSON line")
     p.add_argument("--re-arm", choices=("streamed", "resident"),
                    default=None,
                    help="internal: run ONE arm of the re section "
@@ -1664,6 +1903,8 @@ def main(argv: list[str] | None = None) -> int:
         return score_arm_main(args)
     if args.re_arm:
         return re_arm_main(args)
+    if args.cd_fused_arm:
+        return cd_fused_arm_main(args)
 
     import jax
 

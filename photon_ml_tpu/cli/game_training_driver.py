@@ -295,6 +295,13 @@ def main(argv: list[str] | None = None) -> dict:
                         help="override config re_retirement: freeze "
                              "converged entities between CD sweeps "
                              "(streamed random effects only)")
+    parser.add_argument("--cd-fused", choices=("on", "off"),
+                        default=None,
+                        help="override config cd_fused: one streamed "
+                             "store pass per CD cycle accumulates every "
+                             "coordinate's statistics (Jacobi solves "
+                             "against cycle-start offsets); requires "
+                             "chunk_rows and smooth regularization")
     parser.add_argument("--telemetry", choices=("off", "metrics", "trace"),
                         default=None,
                         help="override config telemetry: pipeline "
@@ -353,6 +360,8 @@ def main(argv: list[str] | None = None) -> dict:
         config.re_chunk_entities = args.re_chunk_entities
     if args.re_retirement is not None:
         config.re_retirement = args.re_retirement == "on"
+    if args.cd_fused is not None:
+        config.cd_fused = args.cd_fused == "on"
     if args.telemetry is not None:
         config.telemetry = args.telemetry
     if args.telemetry_dir is not None:

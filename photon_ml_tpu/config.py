@@ -306,6 +306,20 @@ class TrainingConfig:
     # wakes if its offsets drift past the tolerance, so the final model
     # stays within solver tolerance of the retirement-off fit.
     re_retirement: bool = True
+    # Fused CD super-sweep (game/fused_sweep.py, ISSUE 11): when true,
+    # each coordinate-descent cycle is ONE streamed store pass that
+    # accumulates the fixed effect's loss/grad/Hessian-diagonal
+    # partials AND every random effect's per-entity statistics, then
+    # solves all coordinates against cycle-START offsets (Jacobi
+    # staleness) — ~1 data pass per cycle instead of C coordinates ×
+    # solver iterations.  Per-cycle progress is one damped Newton step
+    # per coordinate, so fused runs want MORE (cheap) cycles
+    # (n_iterations) than per-coordinate runs; both converge to the
+    # same block-stationary point.  Requires chunk_rows (the fixed
+    # effect's chunk grid is the master cycle grid), exactly one
+    # fixed-effect coordinate, smooth regularization (NONE/L2) on every
+    # coordinate, no locked coordinates, and single-device execution.
+    cd_fused: bool = False
     # Warm-path artifact caches (photon_ml_tpu.cache): plan_cache_dir
     # persists compiled GRR plans keyed by dataset fingerprint ×
     # plan-config × planner version, so the second run of a workload
@@ -439,6 +453,39 @@ class TrainingConfig:
                 raise ValueError(
                     "normalization requires resident feature statistics; "
                     "not supported with chunked training (chunk_rows)")
+        if self.cd_fused:
+            if self.chunk_rows is None:
+                raise ValueError(
+                    "cd_fused requires chunked training (chunk_rows): "
+                    "the fixed effect's chunk grid is the fused cycle's "
+                    "master grid")
+            if self.locked_coordinates:
+                raise ValueError(
+                    "cd_fused does not support locked_coordinates (the "
+                    "fused pass composes every coordinate's margins "
+                    "from live coefficients)")
+            if self.n_devices is not None:
+                raise ValueError(
+                    "cd_fused is single-device (the fused per-chunk "
+                    "program is not mesh-sharded); drop n_devices")
+            fixed = [c for c in self.coordinates
+                     if c.name in self.update_sequence
+                     and c.kind == CoordinateKind.FIXED_EFFECT]
+            if len(fixed) != 1:
+                raise ValueError(
+                    "cd_fused requires exactly one fixed-effect "
+                    f"coordinate in update_sequence (got {len(fixed)})")
+            for c in self.coordinates:
+                if (c.name in self.update_sequence
+                        and c.optimizer.regularization
+                        not in (RegularizationType.NONE,
+                                RegularizationType.L2)):
+                    raise ValueError(
+                        "cd_fused requires smooth regularization "
+                        "(NONE or L2) on every coordinate; "
+                        f"'{c.name}' uses "
+                        f"{c.optimizer.regularization.value} — the "
+                        "Jacobi Newton solves have no proximal step")
         if self.n_devices is not None:
             if self.n_devices <= 0:
                 raise ValueError("n_devices must be positive")

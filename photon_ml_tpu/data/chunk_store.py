@@ -294,6 +294,37 @@ def decode_entity_chunk(meta: dict, arrays) -> dict:
 ENTITY_CHUNK_CODEC = (encode_entity_chunk, decode_entity_chunk)
 
 
+# Fused-cycle sidecar chunks (ISSUE 11): the cycle-aligned layout
+# co-locates, per EXAMPLE chunk, every random effect's per-row entity
+# index + (projected) feature planes next to the fixed-effect chunk the
+# same rows live in — so ONE prefetched chunk pair feeds every
+# coordinate of a fused CD cycle.  Payloads are flat name → ndarray
+# maps ("<coordinate>.x" [R, p], "<coordinate>.idx" [R]); the kind tag
+# keeps a fused sidecar from ever decoding as a scoring chunk.
+
+
+def encode_fused_chunk(chunk: dict) -> tuple[dict, dict]:
+    """Fused-training sidecar chunk → (manifest, arrays)."""
+    arrays = {k: np.asarray(v) for k, v in chunk.items()}
+    meta = {"version": CHUNK_FORMAT_VERSION, "kind": "fused_rows",
+            "keys": sorted(arrays)}
+    return meta, arrays
+
+
+def decode_fused_chunk(meta: dict, arrays) -> dict:
+    """Inverse of ``encode_fused_chunk``; memmap views pass through."""
+    if meta.get("version") != CHUNK_FORMAT_VERSION:
+        raise ValueError(f"chunk format {meta.get('version')!r} != "
+                         f"{CHUNK_FORMAT_VERSION}")
+    if meta.get("kind") != "fused_rows":
+        raise ValueError(f"chunk kind {meta.get('kind')!r} != "
+                         "'fused_rows'")
+    return {k: arrays[k] for k in meta["keys"]}
+
+
+FUSED_CHUNK_CODEC = (encode_fused_chunk, decode_fused_chunk)
+
+
 def array_content_key(arrays, cfg: dict) -> str:
     """Content fingerprint for chunk payloads derived from plain host
     arrays (the streamed-RE analog of ``store_key``): exact input
@@ -406,6 +437,65 @@ def _open_npz_mmap(path: str) -> dict:
             for name, dtype, shape, offset in _npz_index(path)}
 
 
+class SharedChunkWindow:
+    """One LRU residency budget shared by SEVERAL chunk stores.
+
+    The legacy (per-coordinate) CD cycle streams the fixed-effect store
+    and each random effect's entity store in turn; with per-store
+    windows each coordinate pins its own ``host_max_resident`` chunks
+    for the whole descent, so the cycle's true host footprint is
+    (window × streamed coordinates) and the coordinates thrash each
+    other's budget expectations (ISSUE 11 satellite).  Registering the
+    stores in one group makes ``budget`` the TOTAL decoded-chunk bound
+    across all of them: admission evicts the globally least-recently-
+    used chunk, whichever store owns it — the active coordinate's sweep
+    naturally fills the window, and the previous coordinate's stale
+    chunks are the first to go.
+
+    Lock order: the group lock is always taken FIRST, store locks
+    second (``admit``/``touch`` are called by stores OUTSIDE their own
+    lock); eviction is a reference drop, so a reader holding a chunk
+    reference is never invalidated.
+    """
+
+    def __init__(self, budget: int):
+        self.budget = max(1, int(budget))
+        self._lock = threading.RLock()
+        # (id(store), chunk index) -> store, in LRU order.
+        self._order: OrderedDict = OrderedDict()
+        self.evictions = 0
+
+    @property
+    def n_resident(self) -> int:
+        with self._lock:
+            return len(self._order)
+
+    def admit(self, store: "ChunkStore", i: int) -> None:
+        with self._lock:
+            key = (id(store), i)
+            if key in self._order:
+                self._order.move_to_end(key)
+                return
+            while len(self._order) >= self.budget:
+                (_, j), victim = self._order.popitem(last=False)
+                victim._drop(j)
+                self.evictions += 1
+            self._order[key] = store
+
+    def touch(self, store: "ChunkStore", i: int) -> None:
+        with self._lock:
+            key = (id(store), i)
+            if key in self._order:
+                self._order.move_to_end(key)
+
+    def drop_store(self, store: "ChunkStore") -> None:
+        """Forget every entry owned by ``store`` (its window was
+        cleared directly, e.g. ``drop_resident``)."""
+        with self._lock:
+            for key in [k for k, s in self._order.items() if s is store]:
+                del self._order[key]
+
+
 class ChunkStore:
     """Spilled chunks on disk + an LRU window of decoded host chunks.
 
@@ -421,12 +511,17 @@ class ChunkStore:
     """
 
     def __init__(self, spill_dir: str, key: str, n_chunks: int,
-                 host_max_resident: int = 2, rebuild=None, codec=None):
+                 host_max_resident: int = 2, rebuild=None, codec=None,
+                 window_group: "SharedChunkWindow | None" = None):
         self.dir = os.path.join(spill_dir, "chunks")
         self.key = key
         self.n_chunks = n_chunks
         self.host_max_resident = max(1, int(host_max_resident))
         self._rebuild = rebuild
+        # Shared residency budget across stores (ISSUE 11 satellite):
+        # when set, the GROUP owns eviction — this store's window is
+        # bounded by the group's total budget, not its own count.
+        self._window_group = window_group
         # (encode, decode) pair; default is the SparseBatch chunk codec
         # (training), ``(encode_array_chunk, decode_array_chunk)`` for
         # the scoring pipeline's flat array-dict chunks.
@@ -477,6 +572,18 @@ class ChunkStore:
         return total
 
     def _admit(self, i: int, chunk) -> None:
+        if self._window_group is not None:
+            # Group-governed residency: install locally, then let the
+            # group evict the global LRU (possibly from another store).
+            # The group call happens OUTSIDE this store's lock — lock
+            # order is group first, store second, everywhere.
+            with self._lock:
+                self._resident[i] = chunk
+                self._resident.move_to_end(i)
+                self.peak_resident = max(self.peak_resident,
+                                         len(self._resident))
+            self._window_group.admit(self, i)
+            return
         with self._lock:
             if i in self._resident:
                 self._resident.move_to_end(i)
@@ -487,12 +594,39 @@ class ChunkStore:
             self.peak_resident = max(self.peak_resident,
                                      len(self._resident))
 
+    def _drop(self, i: int) -> None:
+        """Group-eviction callback: forget chunk ``i`` (ref drop)."""
+        with self._lock:
+            self._resident.pop(i, None)
+
+    def join_window_group(self, group: "SharedChunkWindow | None") -> None:
+        """Install (or clear) a shared residency group on a live store.
+
+        Chunks already resident are registered with the group in their
+        current LRU order (possibly evicting under the group's budget),
+        so a store built before the group existed — the fixed-effect
+        chunked batch comes out of dataset prep, streamed-RE stores out
+        of the coordinate builders — joins with consistent accounting.
+        """
+        old = self._window_group
+        if old is not None and old is not group:
+            old.drop_store(self)
+        self._window_group = group
+        if group is None:
+            return
+        with self._lock:
+            resident = list(self._resident)
+        for i in resident:
+            group.admit(self, i)
+
     def drop_resident(self) -> None:
         """Free the whole window (requires quiescence — see
         ``assert_quiesced``)."""
         self.assert_quiesced()
         with self._lock:
             self._resident.clear()
+        if self._window_group is not None:
+            self._window_group.drop_store(self)
 
     # -- reader accounting (prefetch quiescence) ---------------------------
 
@@ -573,7 +707,12 @@ class ChunkStore:
                 self.access_log.append(i)
                 hit = self._resident[i]
                 telemetry.count("store.hits")
-                return hit
+            else:
+                hit = None
+        if hit is not None:
+            if self._window_group is not None:
+                self._window_group.touch(self, i)
+            return hit
         chunk = self._load(i)
         self._admit(i, chunk)
         return chunk

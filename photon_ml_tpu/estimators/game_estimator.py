@@ -554,7 +554,38 @@ class GameEstimator:
                 # Coordinate was registered under entity_key by the
                 # builder; expose it under the coordinate name.
                 coords[coord_cfg.name].name = coord_cfg.name
+        self._share_chunk_window(coords)
         return coords
+
+    def _share_chunk_window(self, coords: dict) -> None:
+        """One LRU residency budget across every store-backed
+        coordinate (ISSUE 11 satellite): the legacy per-coordinate CD
+        cycle streams the fixed effect's store and each streamed RE's
+        store in turn, and per-store windows pinned
+        (host_max_resident × stores) chunks — each coordinate's sweep
+        thrashing the others' budget expectation.  Grouping makes
+        ``host_max_resident`` the TOTAL decoded-chunk bound for the
+        whole descent; the active coordinate's sweep naturally fills
+        the window and the previous coordinate's stale chunks evict
+        first."""
+        self._chunk_window_group = None
+        stores = []
+        for coord in coords.values():
+            chunked = getattr(coord, "chunked", None)
+            if chunked is not None and getattr(chunked, "store",
+                                               None) is not None:
+                stores.append(chunked.store)
+            store = getattr(coord, "store", None)
+            if store is not None:
+                stores.append(store)
+        if len(stores) < 2:
+            return
+        from photon_ml_tpu.data.chunk_store import SharedChunkWindow
+
+        group = SharedChunkWindow(self.config.host_max_resident)
+        for store in stores:
+            store.join_window_group(group)
+        self._chunk_window_group = group
 
     # -- model export ------------------------------------------------------
 
@@ -987,6 +1018,49 @@ class GameEstimator:
                 snap = self._model_snapshot(coords, coefficients)
                 return self._evaluate(snap, validation)
 
+        fused = None
+        if cfg.cd_fused:
+            # Fused CD super-sweep (ISSUE 11): one streamed store pass
+            # per cycle accumulates every coordinate's statistics.
+            # Config.validate() already enforced the structural
+            # requirements (chunk_rows, one fixed effect, smooth reg,
+            # no locked coordinates, single device).
+            from photon_ml_tpu.data.chunk_store import (
+                SharedChunkWindow,
+                resolve_spill_dir,
+            )
+            from photon_ml_tpu.game.fused_sweep import (
+                build_fused_cycle_engine,
+            )
+
+            spill = resolve_spill_dir(cfg.spill_dir)
+            group = getattr(self, "_chunk_window_group", None)
+            if group is None and spill is not None:
+                # The fused pass consumes FE chunk i AND sidecar chunk
+                # i together every step; without a shared group each
+                # spilled store pins its own host_max_resident window —
+                # 2× the documented budget in the COMMON fused shape
+                # (one spilled FE store, resident REs, so
+                # _share_chunk_window saw < 2 stores).
+                fe_store = next(
+                    (c.chunked.store for c in coords.values()
+                     if getattr(c, "chunked", None) is not None
+                     and getattr(c.chunked, "store", None) is not None),
+                    None)
+                if fe_store is not None:
+                    group = SharedChunkWindow(cfg.host_max_resident)
+                    fe_store.join_window_group(group)
+                    self._chunk_window_group = group
+            fused = build_fused_cycle_engine(
+                train, coords, cfg.update_sequence,
+                re_shards={c.name: c.feature_shard
+                           for c in cfg.coordinates},
+                spill_dir=spill,
+                host_max_resident=cfg.host_max_resident,
+                prefetch_depth=cfg.prefetch_depth,
+                retirement=cfg.re_retirement,
+                window_group=group,
+            )
         cd = run_coordinate_descent(
             coordinates=coords,
             update_sequence=cfg.update_sequence,
@@ -998,6 +1072,7 @@ class GameEstimator:
             resume=cfg.resume and checkpointing,
             run_logger=run_logger,
             checkpointer=checkpointer,
+            fused_engine=fused,
         )
         model = self._to_game_model(coords, cd)
         if cd.validation_history:
@@ -1056,7 +1131,10 @@ class GameEstimator:
             grid_points = self._grid_points()
             name = self._swept_coordinate_name()
             if (len(grid_points) > 1 and name is not None
-                    and set(self.config.reg_weight_grid) == {name}):
+                    and set(self.config.reg_weight_grid) == {name}
+                    and not self.config.cd_fused):
+                # cd_fused trains grid points as separate fused fits —
+                # the swept lane machinery solves per-coordinate.
                 # Checkpointing no longer forces the sequential path
                 # (ISSUE 9): the swept fit snapshots its lane state per
                 # sweep and its solver state per iteration.

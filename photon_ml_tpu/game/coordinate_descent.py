@@ -166,6 +166,24 @@ def _call_validator(validator, coefs, total):
     return validator(coefs, total)
 
 
+def _record_validation(validator, coefs, total, it, validation_history,
+                       run_logger) -> None:
+    """One per-sweep validation: evaluate, append to the history, log
+    (shared by the per-coordinate and fused loops — one place for the
+    metric-to-fields conversion)."""
+    with telemetry.span("cd_validation", cat="cd", iteration=it + 1):
+        metric = _call_validator(validator, coefs, total)
+    validation_history.append(metric)
+    if isinstance(metric, dict):
+        fields = {str(getattr(k, "value", k)): float(v)
+                  for k, v in metric.items()}
+    else:
+        fields = {"metric": float(metric)}
+    logger.info("CD iter %d validation %s", it + 1, fields)
+    if run_logger is not None:
+        run_logger.event("cd_validation", iteration=it + 1, **fields)
+
+
 @dataclasses.dataclass
 class CoordinateDescentResult:
     """Trained coefficients per coordinate + per-iteration history."""
@@ -191,6 +209,7 @@ def run_coordinate_descent(
     resume: bool = False,
     run_logger=None,
     checkpointer=None,
+    fused_engine=None,
 ) -> CoordinateDescentResult:
     """Run GAME coordinate descent.
 
@@ -229,7 +248,17 @@ def run_coordinate_descent(
         defaults when omitted.  While the loop runs it is also the
         ACTIVE checkpoint session, so the streaming solvers snapshot
         mid-solve state under the loop's (iteration, coordinate) scope.
+      fused_engine: optional ``game.fused_sweep.FusedCycleEngine``
+        (ISSUE 11): each CD iteration becomes ONE fused streamed pass
+        that accumulates every coordinate's statistics, followed by the
+        Jacobi solves — ~1 store pass per cycle instead of C ×
+        solver-iterations.  All coordinate updates within a cycle are
+        computed against cycle-START offsets (Jacobi staleness — the
+        ``validator``'s ``total_scores`` are therefore the cycle-start
+        scores).  Locked coordinates are not supported on this path.
     """
+    if fused_engine is not None and locked_coordinates:
+        raise ValueError("fused CD does not support locked coordinates")
     locked_coordinates = locked_coordinates or {}
     initial_coefficients = dict(initial_coefficients or {})
     for name in update_sequence:
@@ -245,6 +274,7 @@ def run_coordinate_descent(
     start_pos = 0
     ckpt_scores: dict = {}
     restored_extra: dict = {}
+    fused_state: dict | None = None
     if resume:
         if checkpointer is None:
             raise ValueError("resume=True requires checkpoint_dir")
@@ -253,9 +283,41 @@ def run_coordinate_descent(
             start_iteration = loaded["iteration"]
             start_pos = loaded["coord_pos"]
             initial_coefficients.update(loaded["coefs"])
-            ckpt_scores = {k: jnp.asarray(v)
-                           for k, v in loaded["scores"].items()}
             restored_extra = loaded["extra"]
+            # Fused-cycle engine state rides re_state under a reserved
+            # key (ISSUE 11); it is restored by the fused branch below
+            # and the per-coordinate loop skips it (no such coordinate).
+            fused_state = (loaded["re_state"] or {}).get("__cd_fused__")
+            if fused_state is not None and fused_engine is None:
+                # A fused checkpoint pairs post-Jacobi-step coefficients
+                # with cycle-START score planes (the fused loop never
+                # reads the scores back — it composes margins from
+                # coefficients).  The per-coordinate loop DOES read
+                # them as a consistent pair, so adopting this snapshot
+                # would train every coordinate against offsets one
+                # Jacobi step stale.  Refuse rather than drift.
+                raise ValueError(
+                    "checkpoint was written by a fused run (cd_fused); "
+                    "resume with cd_fused=true or start a fresh "
+                    "checkpoint_dir")
+            if fused_state is None and fused_engine is not None:
+                # Symmetric refusal: a legacy checkpoint's iteration
+                # count budgets FULL inner solves — adopting it as a
+                # fused start (start_iteration of n_iterations damped
+                # Jacobi cycles, mid-sweep position dropped, engine
+                # state fresh) would "complete" severely
+                # under-converged with no error.
+                raise ValueError(
+                    "checkpoint was written by a per-coordinate run; "
+                    "resume with cd_fused=false or start a fresh "
+                    "checkpoint_dir")
+            if fused_engine is None:
+                # Device placement of the restored score planes is the
+                # per-coordinate path's business only — the fused loop
+                # recomputes scores from coefficients and would drop
+                # these [n] planes unread (wasted H2D at scale).
+                ckpt_scores = {k: jnp.asarray(v)
+                               for k, v in loaded["scores"].items()}
             # Streamed-RE runtime state (retirement masks, solved
             # offsets, resident coefficient blocks): the coordinate's
             # canonical blocks become the warm start, so its own
@@ -272,6 +334,17 @@ def run_coordinate_descent(
             if run_logger is not None:
                 run_logger.event("cd_resume", iteration=start_iteration,
                                  coord_pos=start_pos)
+
+    if fused_engine is not None:
+        # Fused super-sweep (ISSUE 11): every iteration is ONE streamed
+        # pass + Jacobi solves; no per-coordinate score planes are
+        # carried as training state, so the per-coordinate preamble
+        # below (which would stream a scoring pass per warm start) is
+        # bypassed entirely.
+        return _run_fused_cycles(
+            fused_engine, coordinates, update_sequence, n_iterations,
+            validator, initial_coefficients, checkpointer, run_logger,
+            start_iteration, restored_extra, fused_state)
 
     coefs: dict = {}
     scores: dict = {}
@@ -343,25 +416,18 @@ def run_coordinate_descent(
                 n_iterations,
                 seed_diag=(partial_diag if it == start_iteration
                            else None))
+            # Completed CD cycle: the denominator of the report's
+            # passes-per-cycle metric (ISSUE 11 — sweep odometer ÷
+            # cycles is how the C → ~1 fused drop is measured).
+            telemetry.count("cd.cycles")
             # Normalized to the serialized (plain-dict) diagnostic form
             # so ``CoordinateDescentResult.history`` is uniformly typed
             # whether or not the run was resumed (the restored prefix
             # arrives serialized from the checkpoint).
             history.append(_serialize_history([iter_diag])[0])
             if validator is not None:
-                with telemetry.span("cd_validation", cat="cd",
-                                    iteration=it + 1):
-                    metric = _call_validator(validator, coefs, total)
-                validation_history.append(metric)
-                if isinstance(metric, dict):
-                    fields = {str(getattr(k, "value", k)): float(v)
-                              for k, v in metric.items()}
-                else:
-                    fields = {"metric": float(metric)}
-                logger.info("CD iter %d validation %s", it + 1, fields)
-                if run_logger is not None:
-                    run_logger.event("cd_validation", iteration=it + 1,
-                                     **fields)
+                _record_validation(validator, coefs, total, it,
+                                   validation_history, run_logger)
             if checkpointer is not None:
                 checkpointer.maybe_save_cd(
                     it + 1, coefs,
@@ -369,6 +435,96 @@ def run_coordinate_descent(
                     re_state=_re_states(), extra=_extra(),
                     final=(it + 1 == n_iterations))
 
+    return CoordinateDescentResult(
+        coefficients=coefs,
+        scores=scores,
+        total_scores=total,
+        history=history,
+        validation_history=validation_history,
+    )
+
+
+def _run_fused_cycles(engine, coordinates, update_sequence,
+                      n_iterations, validator, initial_coefficients,
+                      checkpointer, run_logger, start_iteration,
+                      restored_extra, fused_state):
+    """The fused-CD loop (ISSUE 11): one streamed super-sweep per
+    iteration, harvested statistics solved once per cycle, offsets
+    updated once per cycle (Jacobi).  Checkpoints land at cycle
+    boundaries — the engine's retirement/step-scale state rides
+    ``re_state["__cd_fused__"]`` so a resumed run steps identically."""
+    engine.restore_runtime_state(fused_state)
+    trainable = [n for n in dict.fromkeys(update_sequence)]
+    coefs: dict = {}
+    for name in trainable:
+        if name in initial_coefficients:
+            coefs[name] = initial_coefficients[name]
+        else:
+            coefs[name] = coordinates[name].initial_coefficients()
+
+    history = _serialize_history(restored_extra.get("history") or [])
+    validation_history = _revive_validation(
+        restored_extra.get("validation_history"))
+
+    def _extra() -> dict:
+        return {"history": _serialize_history(history),
+                "validation_history": _serialize_validation(
+                    validation_history)}
+
+    scores: dict = {}
+    total = None
+    ckpt_session = (_ckpt.session(checkpointer) if checkpointer is not None
+                    else contextlib.nullcontext())
+    with ckpt_session:
+        for it in range(start_iteration, n_iterations):
+            t0 = time.perf_counter()
+            with telemetry.span("cd_fused_cycle", cat="cd",
+                                iteration=it + 1):
+                coefs, scores, total, iter_diag = engine.run_cycle(coefs)
+            elapsed = time.perf_counter() - t0
+            telemetry.count("cd.cycles")
+            telemetry.count("cd.coordinate_updates", len(trainable))
+            history.append(_serialize_history([iter_diag])[0])
+            # Cycle-level progress (the fused analog of the legacy
+            # loop's per-coordinate updates; per-CHUNK progress comes
+            # from the engine's train.cd_fused stage).
+            _mon.progress("cd", it + 1, n_iterations, unit="cycles",
+                          iteration=it + 1)
+            fe_diag = iter_diag.get(engine.fe_name, {})
+            logger.info(
+                "CD fused cycle %d in %.2fs (value %s, alpha %s)",
+                it + 1, elapsed, fe_diag.get("value"),
+                fe_diag.get("alpha"))
+            if run_logger is not None:
+                retired = sum(d.get("entities_retired", 0)
+                              for d in iter_diag.values()
+                              if isinstance(d, dict))
+                run_logger.event(
+                    "cd_fused_cycle", iteration=it + 1,
+                    duration_s=round(elapsed, 4),
+                    value=fe_diag.get("value"),
+                    grad_norm=fe_diag.get("grad_norm"),
+                    alpha=fe_diag.get("alpha"),
+                    entities_retired=retired)
+            if validator is not None:
+                # ``total`` holds the CYCLE-START scores (Jacobi
+                # staleness — documented in run_coordinate_descent);
+                # snapshot scoring of held-out data uses the fresh
+                # coefficients either way.
+                _record_validation(validator, coefs, total, it,
+                                   validation_history, run_logger)
+            if checkpointer is not None:
+                checkpointer.maybe_save_cd(
+                    it + 1, coefs,
+                    scores={**scores, "__cd_total__": total},
+                    re_state={"__cd_fused__": engine.runtime_state()},
+                    extra=_extra(),
+                    final=(it + 1 == n_iterations))
+
+    # One final pass brings the score planes to the FINAL coefficients
+    # (each cycle's planes are at its start) — counted as an auxiliary
+    # sweep, so passes/cycle stays (N+1)/N ≈ 1.
+    scores, total = engine.score_pass(coefs)
     return CoordinateDescentResult(
         coefficients=coefs,
         scores=scores,

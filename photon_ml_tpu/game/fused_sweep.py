@@ -1,0 +1,844 @@
+"""Fused CD super-sweep: ONE streamed store pass per coordinate-descent
+cycle (ISSUE 11 tentpole).
+
+The per-coordinate CD loop pays one full data stream per objective
+evaluation per coordinate — C coordinates × (solver iterations + line
+search) store passes per cycle.  PR 4 already proved at inference that
+one streamed pass can feed a single fused device program covering the
+fixed effect and every random effect; this module gives TRAINING the
+same shape:
+
+- **Cycle-aligned chunks**: the fixed-effect chunk grid (the round-8
+  ``data.chunked_batch`` store) is the master grid; a *sidecar* chunk
+  per example chunk co-locates every random effect's per-row entity
+  index and (projected) feature planes (``data.chunk_store``
+  ``FUSED_CHUNK_CODEC``, content-keyed spill), so one prefetched chunk
+  pair feeds all coordinates.
+- **One fused per-chunk device program** (mirroring the streaming
+  scorer's ``_CoordSpec`` fusion, but emitting statistics instead of
+  margins): margins are composed from the CURRENT coefficients inside
+  the program (fixed-effect contraction + every RE's coefficient-row
+  gather-dot), and from the shared per-example loss derivatives it
+  accumulates the fixed effect's (value, gradient, Hessian-diagonal)
+  partials AND every random effect's segment-summed per-entity
+  statistics (gradient [E, p] and Gauss–Newton Gram [E, p, p]).
+  Retirement masks gate which entities' Gram statistics are even
+  accumulated.
+- **Once-per-cycle Jacobi update**: after the pass, the fixed effect
+  takes one diagonally preconditioned Newton step and every ACTIVE
+  entity one exact regularized Newton solve of its p×p system — all
+  against CYCLE-START offsets ("Parallel training of linear models
+  without compromising convergence", PAPERS.md, is the staleness
+  convergence reference).  A cycle therefore costs ~1 store pass
+  instead of C × solver-iterations; per-cycle progress is a damped
+  Newton step rather than a full inner solve, so fused fits run more
+  (cheap) cycles — both paths converge to the same block-stationary
+  point (tested to documented tolerance).
+- **Safeguard**: the joint objective value comes out of the same pass;
+  if a cycle's value rose, the global step scale halves (and recovers
+  geometrically on progress) — the Jacobi analog of a line search that
+  costs zero extra passes.
+
+Offsets/score planes: the fused program composes margins from
+coefficients directly, so NO per-coordinate score planes are training
+state — per-coordinate scores still come out of each pass (one [n]
+plane per coordinate, the same D2H the scorer pays) for validation,
+retirement bookkeeping, and the CD result contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import convergence as _conv
+from photon_ml_tpu.telemetry import monitor as _mon
+from photon_ml_tpu.ops.objective import GLMObjective, _elementwise_square_batch
+
+logger = logging.getLogger(__name__)
+
+Array = jax.Array
+
+# Ridge added to every Newton system: keeps the FE diagonal and the
+# per-entity Gram solvable at zero curvature (masked-out entities,
+# projected padding columns) without moving any real solution.
+_RIDGE = 1e-6
+_MIN_ALPHA = 1.0 / 64.0
+
+
+# ---------------------------------------------------------------------------
+# THE fused per-chunk device program.  Jitted at module level (the loss
+# is the only static argument) so every engine instance for the same
+# task shares one compile, and every chunk of a run replays it — zero
+# new compiles across fused cycles after warmup (guard-pinned).
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _fused_chunk(loss, w_fe, re_tabs, re_actives, batch, re_xs, re_idxs):
+    """One chunk's fused statistics.
+
+    Args:
+      loss: static ``PointwiseLoss``.
+      w_fe: [d] fixed-effect coefficients.
+      re_tabs: tuple of [E_r + 1, p_r] flattened coefficient tables
+        (last row = padding/unseen dump row, all-zero).
+      re_actives: tuple of [E_r + 1] float gates — 1.0 accumulates the
+        entity's statistics, 0.0 (retired / dump row) skips them.
+      batch: the fixed-effect ``SparseBatch`` chunk (host offsets are
+        IGNORED: margins are composed from coefficients here).
+      re_xs: tuple of [R, p_r] per-row (projected) feature planes.
+      re_idxs: tuple of [R] int32 flattened entity indices (padding
+        rows point at the dump row E_r).
+
+    Returns (value, fe_grad [d], fe_hess_diag [d], re_grads, re_grams,
+    fe_scores [R], re_scores tuple of [R]) — data-side partials only;
+    regularization/prior are example-independent and added once by the
+    solves.
+    """
+    fe_scores = batch.x_dot(w_fe)
+    off = jnp.zeros_like(fe_scores)
+    re_scores = []
+    for x, tab, idx in zip(re_xs, re_tabs, re_idxs):
+        s = jnp.sum(x * tab[idx], axis=-1)
+        re_scores.append(s)
+        off = off + s
+    m = fe_scores + off
+    wl = batch.weights * batch.mask
+    f = jnp.sum(wl * loss.loss(m, batch.labels))
+    dl = wl * loss.d1(m, batch.labels)
+    d2 = wl * loss.d2(m, batch.labels)
+    fe_g = batch.xt_dot(dl)
+    fe_h = _elementwise_square_batch(batch).xt_dot(d2)
+    re_gs, re_Gs = [], []
+    for x, tab, idx, act in zip(re_xs, re_tabs, re_idxs, re_actives):
+        gate = act[idx]
+        gd1 = dl * gate
+        gd2 = d2 * gate
+        E1, p = tab.shape
+        g = jnp.zeros((E1, p), jnp.float32).at[idx].add(gd1[:, None] * x)
+        G = jnp.zeros((E1, p, p), jnp.float32).at[idx].add(
+            gd2[:, None, None] * x[:, :, None] * x[:, None, :])
+        re_gs.append(g)
+        re_Gs.append(G)
+    return (f, fe_g, fe_h, tuple(re_gs), tuple(re_Gs), fe_scores,
+            tuple(re_scores))
+
+
+@jax.jit
+def _acc_add(acc, out):
+    """Tree-add of the accumulated statistics (value/grad/hess/RE
+    stats) — one dispatch per chunk, like the chunked objective's
+    combine."""
+    return jax.tree.map(lambda a, b: a + b, acc, out)
+
+
+@jax.jit
+def _fe_step(obj: GLMObjective, w: Array, g: Array, h: Array, alpha):
+    """Diagonally preconditioned Newton step on the fixed effect from
+    the gathered (grad, Hessian-diagonal) partials; regularization and
+    prior are added HERE, outside the chunk loop (the chunked
+    objective's rule)."""
+    g = g + obj.reg.l2_gradient(w)
+    h = h + obj.reg.l2_hessian_diagonal(w)
+    if obj.prior is not None:
+        g = g + obj.prior.gradient(w)
+        h = h + obj.prior.hessian_diagonal()
+    step = g / jnp.maximum(h, _RIDGE)
+    w_new = w - alpha * step
+    return w_new, jnp.max(jnp.abs(alpha * step)), jnp.linalg.norm(g)
+
+
+@jax.jit
+def _re_step(tab: Array, g: Array, G: Array, active: Array, lam,
+             alpha):
+    """Per-entity regularized Newton solve from the segment-summed
+    statistics: Δ_e = (G_e + (λ+δ)I)⁻¹ (g_e + λ w_e), applied to
+    ACTIVE entities only.  Padding columns (projected buckets narrower
+    than the table) have zero x, zero w, zero g → Δ = 0 exactly.
+
+    Returns (new table [E+1, p], per-entity UNDAMPED |Δ|_∞ [E+1]): the
+    movement plane is the full Newton step's norm, not the α-damped
+    step actually applied — retirement compares it against the solver
+    tolerance, and gating on the damped step would loosen the
+    effective threshold to tolerance/α (up to 64× at ``_MIN_ALPHA``),
+    freezing entities whose own residual is still large."""
+    E1, p = tab.shape
+    eye = jnp.eye(p, dtype=tab.dtype)
+    g_tot = g + lam * tab
+    A = G + (lam + _RIDGE) * eye[None]
+    delta = jnp.linalg.solve(A, g_tot[..., None])[..., 0]
+    dw = alpha * delta
+    gate = active[:, None] > 0.0
+    tab_new = jnp.where(gate, tab - dw, tab)
+    # The dump row stays pinned at zero (unseen/padding rows gather it).
+    tab_new = tab_new.at[-1].set(0.0)
+    move = jnp.where(active > 0.0, jnp.max(jnp.abs(delta), axis=-1), 0.0)
+    return tab_new, move
+
+
+@dataclasses.dataclass
+class _FusedRE:
+    """One random effect's fused-cycle bookkeeping."""
+
+    name: str
+    coord: "object"                # the estimator-facing coordinate
+    lam: float                     # smooth L2 weight of its objective
+    tolerance: float               # retirement / movement threshold
+    widths: list[int]              # per-bucket p_b
+    p_max: int
+    n_entities: list[int]
+    boff: np.ndarray               # [buckets] flat entity-index bases
+    E_total: int
+    # Per-entity example-run maps (flat entity order): example ids
+    # sorted by (entity, position) + run starts — the vectorized
+    # per-entity reductions (retirement drift) run off these.
+    ex_sorted: np.ndarray          # [n_r] example ids
+    ent_starts: np.ndarray         # [E_total + 1]
+    # Retirement state (the PR 5 semantics, engine-resident):
+    active: np.ndarray = None      # [E_total] bool
+    solved_off: np.ndarray = None  # [n] offsets at each entity's last solve
+    prev_off: np.ndarray = None    # [n] previous cycle's offsets
+
+    def entity_max(self, per_example: np.ndarray) -> np.ndarray:
+        """[E_total] per-entity max of a per-example plane (one
+        vectorized reduceat; entities with no examples get 0)."""
+        out = np.zeros(self.E_total, np.float32)
+        counts = np.diff(self.ent_starts)
+        nz = counts > 0
+        if self.ex_sorted.size:
+            v = per_example[self.ex_sorted]
+            red = np.maximum.reduceat(v, self.ent_starts[:-1][nz])
+            out[nz] = red
+        return out
+
+
+class FusedCycleEngine:
+    """One-pass-per-cycle fused coordinate descent over a chunked
+    fixed effect + any number of random effects (see module docstring).
+
+    Coefficients cross the boundary in the COORDINATE formats the rest
+    of the stack speaks — [d] for the fixed effect, per-bucket
+    [E_b, p_b] block lists for random effects — and are flattened to
+    device tables internally, so model export, validation scoring, and
+    checkpoints are unchanged.
+    """
+
+    def __init__(self, fe_name: str, fe_coord, res: list[_FusedRE],
+                 n_examples: int, prefetch_depth: int = 2,
+                 retirement: bool = True, sidecar_store=None,
+                 sidecar_resident: list | None = None):
+        self.fe_name = fe_name
+        self.fe_coord = fe_coord
+        self.chunked = fe_coord.chunked
+        self.objective = fe_coord.objective
+        self.loss = fe_coord.objective.loss
+        self.res = res
+        self.n = int(n_examples)
+        self.prefetch_depth = int(prefetch_depth)
+        self.retirement = bool(retirement)
+        self._sidecar_store = sidecar_store
+        self._sidecar_resident = sidecar_resident
+        self.alpha = 1.0
+        self.prev_value: float | None = None
+        self.cycles = 0
+        self.last_scores: dict | None = None
+        self.last_total = None
+        # Device-table cache keyed BY IDENTITY of the block list this
+        # engine itself returned last cycle (the streamed-RE
+        # `_is_last_train_output` rule): fused runs take many cheap
+        # cycles, and re-flattening an unchanged [E, p] table host-side
+        # + H2D every cycle is pure waste.  Any caller-substituted
+        # blocks (warm start, resume) miss the cache and re-flatten.
+        self._tab_cache: dict = {}
+
+    # -- coefficient format conversions -------------------------------------
+
+    def _flatten(self, r: _FusedRE, blocks) -> Array:
+        tab = np.zeros((r.E_total + 1, r.p_max), np.float32)
+        for b, blk in enumerate(blocks):
+            lo = int(r.boff[b])
+            tab[lo:lo + r.n_entities[b], : r.widths[b]] = np.asarray(blk)
+        return jnp.asarray(tab)
+
+    def _tab_for(self, r: _FusedRE, blocks) -> Array:
+        cached = self._tab_cache.get(r.name)
+        if cached is not None and cached[0] is blocks:
+            return cached[1]
+        return self._flatten(r, blocks)
+
+    def _unflatten(self, r: _FusedRE, tab: Array) -> list[Array]:
+        tab = np.asarray(tab)
+        out = []
+        for b in range(len(r.n_entities)):
+            lo = int(r.boff[b])
+            out.append(jnp.asarray(
+                tab[lo:lo + r.n_entities[b], : r.widths[b]].copy()))
+        return out
+
+    # -- chunk feed ----------------------------------------------------------
+
+    def _sidecar(self, i: int) -> dict:
+        if self._sidecar_store is not None:
+            return self._sidecar_store.get(i)
+        if self._sidecar_resident is None:     # fixed-effect-only fit
+            return {}
+        return self._sidecar_resident[i]
+
+    def _stream(self):
+        """(i, device (batch, sidecar)) pairs in chunk order, through
+        the round-8 prefetch pipeline when the FE chunks are
+        store-backed."""
+        from photon_ml_tpu.optim.streaming import prefetch_stream
+
+        load = lambda i: (self.chunked.chunk(i), self._sidecar(i))
+        return prefetch_stream(load, jax.device_put,
+                               range(self.chunked.n_chunks),
+                               self.prefetch_depth,
+                               store=self.chunked.store)
+
+    # -- the pass ------------------------------------------------------------
+
+    def _pass(self, w_fe: Array, tabs: list[Array],
+              actives: list[Array]):
+        """One streamed pass: accumulated statistics + per-coordinate
+        score planes at the INPUT coefficients.  Backpressure: chunk
+        i−1's accumulate fences before chunk i dispatches (the round-8
+        rule), and per-example planes D2H-copy asynchronously under
+        later chunks' compute."""
+        K = self.chunked.n_chunks
+        R = self.chunked.chunk_rows
+        names = [r.name for r in self.res]
+        acc = None
+        per_ex: list = []          # (fe_scores, re_scores) per chunk
+        sidecar_store = self._sidecar_store
+        if sidecar_store is not None:
+            sidecar_store.begin_read()
+        try:
+            with telemetry.span("fused_cycle_pass", cat="solver",
+                                chunks=K):
+                telemetry.count("solver.sweeps")
+                for i, placed in self._stream():
+                    batch, sc = placed
+                    re_xs = tuple(sc[n + ".x"] for n in names)
+                    re_idxs = tuple(sc[n + ".idx"] for n in names)
+                    with telemetry.span("chunk_compute", cat="device"):
+                        if acc is not None:
+                            jax.block_until_ready(acc[0])
+                        out = _fused_chunk(
+                            self.loss, w_fe, tuple(tabs),
+                            tuple(actives), batch, re_xs, re_idxs)
+                    stats, planes = out[:5], out[5:]
+                    for pl in (planes[0], *planes[1]):
+                        try:
+                            pl.copy_to_host_async()
+                        except AttributeError:  # photon-lint: disable=swallowed-exception (backends without async D2H; device_get below copies synchronously)
+                            pass
+                    per_ex.append(planes)
+                    acc = stats if acc is None else _acc_add(acc, stats)
+                    # Live fused-cycle progress (ISSUE 11 satellite):
+                    # chunks done/total drives watch/ETA exactly like
+                    # every other instrumented loop.
+                    _mon.progress("train.cd_fused", i + 1, K,
+                                  unit="chunks", cycle=self.cycles + 1)
+        finally:
+            if sidecar_store is not None:
+                sidecar_store.end_read()
+        fe_scores = np.zeros(self.n, np.float32)
+        re_scores = [np.zeros(self.n, np.float32) for _ in self.res]
+        for i, (fe_pl, re_pls) in enumerate(per_ex):
+            lo, hi = self.chunked.chunk_slice(i)
+            fe_scores[lo:hi] = jax.device_get(fe_pl)[: hi - lo]
+            for j, pl in enumerate(re_pls):
+                re_scores[j][lo:hi] = jax.device_get(pl)[: hi - lo]
+        return acc, fe_scores, re_scores
+
+    # -- value bookkeeping ---------------------------------------------------
+
+    def _total_value(self, data_value: float, w_fe: Array,
+                     tabs: list[Array]) -> float:
+        """Joint objective (data + smooth reg + prior) at the
+        coefficients the pass evaluated — the Jacobi safeguard's
+        scalar."""
+        obj = self.objective
+        v = float(data_value) + float(obj.reg.l2_value(w_fe))
+        if obj.prior is not None:
+            v += float(obj.prior.value(w_fe))
+        for r, tab in zip(self.res, tabs):
+            v += 0.5 * r.lam * float(jnp.sum(tab * tab))
+        return v
+
+    # -- one cycle -----------------------------------------------------------
+
+    def run_cycle(self, coefs: dict):
+        """One fused CD cycle: one streamed pass at the given
+        coefficients, then the Jacobi solves.  Returns
+        (new coefficients dict, scores dict AT THE INPUT coefficients,
+        total scores, per-coordinate diagnostics dict)."""
+        w_fe = jnp.asarray(coefs[self.fe_name], jnp.float32)
+        tabs = [self._tab_for(r, coefs[r.name]) for r in self.res]
+        actives = [
+            jnp.asarray(np.concatenate(
+                [r.active.astype(np.float32),
+                 np.zeros(1, np.float32)]))     # dump row stays gated
+            for r in self.res
+        ]
+        telemetry.count("solver.fused_cycle_sweeps")
+        acc, fe_scores, re_scores = self._pass(w_fe, tabs, actives)
+        f_data, fe_g, fe_h, re_gs, re_Gs = acc
+        value = self._total_value(f_data, w_fe, tabs)
+
+        # Jacobi safeguard: a cycle whose value ROSE means the previous
+        # step overshot — halve the global step scale before applying
+        # this cycle's; recover geometrically on progress (zero extra
+        # passes either way).
+        if self.prev_value is not None:
+            if value > self.prev_value + 1e-12 * (1.0
+                                                  + abs(self.prev_value)):
+                self.alpha = max(self.alpha * 0.5, _MIN_ALPHA)
+            else:
+                self.alpha = min(1.0, self.alpha * 1.25)
+        self.prev_value = value
+
+        total = fe_scores.copy()
+        for s in re_scores:
+            total += s
+
+        # Wake retired entities whose offsets drifted past tolerance
+        # since their last solve (their statistics were gated off this
+        # cycle, so they re-enter NEXT cycle — retirement can never
+        # move the final model beyond tolerance).
+        diag: dict = {}
+        new_coefs = dict(coefs)
+        w_fe_new, fe_step, fe_gnorm = _fe_step(
+            self.objective, w_fe, fe_g, fe_h, self.alpha)
+        new_coefs[self.fe_name] = w_fe_new
+        diag[self.fe_name] = {
+            "value": round(value, 8),
+            "grad_norm": round(float(fe_gnorm), 8),
+            "step_inf_norm": round(float(fe_step), 8),
+            "alpha": round(self.alpha, 6),
+            "fused": True,
+        }
+        for j, r in enumerate(self.res):
+            off_r = total - re_scores[j]
+            # Only the entities whose statistics were ACCUMULATED this
+            # cycle may solve: the pass gated on the cycle-START active
+            # mask, so a woken entity re-enters accumulation (and
+            # solving) next cycle.
+            solved_mask = r.active.copy()
+            woken = 0
+            if self.retirement and r.solved_off is not None:
+                retired = ~r.active
+                if retired.any():
+                    drift = r.entity_max(np.abs(off_r - r.solved_off))
+                    woke = retired & (drift >= r.tolerance)
+                    woken = int(woke.sum())
+                    r.active |= woke
+            if r.solved_off is None:
+                r.solved_off = off_r.copy()
+            tab_new, move = _re_step(tabs[j], re_gs[j], re_Gs[j],
+                                     actives[j], r.lam, self.alpha)
+            move = np.asarray(move)[:-1]
+            new_blocks = self._unflatten(r, tab_new)
+            new_coefs[r.name] = new_blocks
+            # Next cycle's _tab_for resolves these very blocks back to
+            # the device table without a host rebuild + H2D.
+            self._tab_cache[r.name] = (new_blocks, tab_new)
+            # Solved entities' offset baseline moves to THIS cycle's
+            # offsets (their statistics were computed against them).
+            if solved_mask.any() and r.ex_sorted.size:
+                per_ex_solved = solved_mask[
+                    np.repeat(np.arange(r.E_total),
+                              np.diff(r.ent_starts))]
+                ex = r.ex_sorted[per_ex_solved]
+                r.solved_off[ex] = off_r[ex]
+            # Retire: solved, step below tolerance, offsets quiet since
+            # the previous cycle (the PR 5 dual criterion).
+            newly = 0
+            if self.retirement:
+                quiet = np.ones(r.E_total, bool)
+                if r.prev_off is not None:
+                    quiet = (r.entity_max(np.abs(off_r - r.prev_off))
+                             < r.tolerance)
+                retire = solved_mask & (move < r.tolerance) & quiet
+                newly = int(retire.sum())
+                r.active &= ~retire
+                if newly:
+                    _conv.re_retirement(r.name, newly,
+                                        int((~r.active).sum()))
+            r.prev_off = off_r.copy()
+            diag[r.name] = {
+                "entities": r.E_total,
+                "entities_solved": int(solved_mask.sum()),
+                "entities_retired": int((~r.active).sum()),
+                "entities_newly_retired": newly,
+                "entities_woken": woken,
+                "fused": True,
+            }
+        self.cycles += 1
+        telemetry.count("solver.iterations")
+        _conv.iteration("fused_cd", self.fe_name, self.cycles, value,
+                        float(fe_gnorm))
+        scores = {self.fe_name: jnp.asarray(fe_scores)}
+        for j, r in enumerate(self.res):
+            scores[r.name] = jnp.asarray(re_scores[j])
+        self.last_scores = scores
+        self.last_total = jnp.asarray(total)
+        return new_coefs, scores, jnp.asarray(total), diag
+
+    def score_pass(self, coefs: dict):
+        """Scores at the GIVEN coefficients via one more fused pass
+        (statistics discarded) — the once-per-fit final pass that
+        brings the result's score planes to the final coefficients.
+        Counted as an auxiliary sweep, so the sweep-odometer identity
+        holds."""
+        w_fe = jnp.asarray(coefs[self.fe_name], jnp.float32)
+        tabs = [self._tab_for(r, coefs[r.name]) for r in self.res]
+        zeros = [jnp.zeros(r.E_total + 1, jnp.float32) for r in self.res]
+        telemetry.count("solver.aux_sweeps")
+        _, fe_scores, re_scores = self._pass(w_fe, tabs, zeros)
+        scores = {self.fe_name: jnp.asarray(fe_scores)}
+        total = fe_scores.copy()
+        for j, r in enumerate(self.res):
+            scores[r.name] = jnp.asarray(re_scores[j])
+            total += re_scores[j]
+        return scores, jnp.asarray(total)
+
+    # -- checkpoint state (ISSUE 9 granularities) ---------------------------
+
+    def _identity_fingerprint(self) -> str:
+        """Config-identity hash of everything the snapshot's semantics
+        depend on (the PR 9 solver-snapshot rule): regularization
+        weights, tolerances, entity/chunk geometry.  A resume after a
+        config edit must REJECT the stale retirement masks / offset
+        baselines / step-scale rather than adopt state computed under
+        different λs — retired-under-old-λ entities would stay frozen
+        (wake only watches offsets) and the stale prev_value would
+        spuriously damp alpha."""
+        import hashlib
+
+        ident = (
+            self.fe_name,
+            float(np.asarray(self.objective.reg.l2_weight)),
+            [(r.name, float(r.lam), float(r.tolerance), int(r.E_total),
+              int(r.p_max)) for r in self.res],
+            int(self.chunked.n_chunks), int(self.chunked.chunk_rows),
+            int(self.chunked.dim),
+            # Retirement mode is snapshot semantics too: a mask frozen
+            # under retirement=True adopted by a retirement=False run
+            # would gate those entities off FOREVER (no wake branch).
+            bool(self.retirement),
+        )
+        return hashlib.blake2b(repr(ident).encode(),
+                               digest_size=16).hexdigest()
+
+    def runtime_state(self) -> dict:
+        """Everything the fused loop carries BETWEEN cycles beyond the
+        coefficients: retirement masks, offset baselines, and the
+        Jacobi step-scale — so a resumed run steps exactly as the
+        uninterrupted run would have."""
+        return {
+            "fingerprint": self._identity_fingerprint(),
+            "alpha": float(self.alpha),
+            "prev_value": (None if self.prev_value is None
+                           else float(self.prev_value)),
+            "cycles": int(self.cycles),
+            "re": {r.name: {
+                "active": np.asarray(r.active),
+                "solved_off": (None if r.solved_off is None
+                               else np.asarray(r.solved_off)),
+                "prev_off": (None if r.prev_off is None
+                             else np.asarray(r.prev_off)),
+            } for r in self.res},
+        }
+
+    def restore_runtime_state(self, state: dict | None) -> None:
+        if not state:
+            return
+        snap = state.get("fingerprint")
+        if snap is not None:
+            snap = str(np.asarray(snap).item()) \
+                if not isinstance(snap, str) else snap
+            cur = self._identity_fingerprint()
+            if snap != cur:
+                raise ValueError(
+                    "fused checkpoint was written under a different "
+                    "configuration (regularization / tolerance / chunk "
+                    "geometry changed); start a fresh checkpoint_dir")
+        self.alpha = float(state.get("alpha", 1.0))
+        pv = state.get("prev_value")
+        self.prev_value = None if pv is None else float(pv)
+        self.cycles = int(state.get("cycles", 0))
+        for r in self.res:
+            st = (state.get("re") or {}).get(r.name)
+            if st is None:
+                continue
+            r.active = np.asarray(st["active"], bool).copy()
+            so = st.get("solved_off")
+            r.solved_off = (None if so is None
+                            else np.asarray(so, np.float32).copy())
+            po = st.get("prev_off")
+            r.prev_off = (None if po is None
+                          else np.asarray(po, np.float32).copy())
+
+
+# ---------------------------------------------------------------------------
+# Engine construction: coordinates (already built by the estimator) →
+# sidecar chunks on the fixed-effect chunk grid + per-RE bookkeeping.
+# ---------------------------------------------------------------------------
+
+
+def _flat_entity_runs(grouping, boff: np.ndarray):
+    """(ex_sorted, ent_starts) over the FLAT entity order (bucket base
+    + slot): example ids sorted by (flat entity, within-entity
+    position) and the [E+1] run starts — the per-entity reduction maps
+    the retirement bookkeeping uses."""
+    E = grouping.n_total_entities
+    flat = boff[grouping.example_bucket] + grouping.example_row
+    order = np.lexsort((grouping.example_col, flat))
+    ex_sorted = order.astype(np.int64)
+    counts = np.bincount(flat[order], minlength=E)
+    starts = np.zeros(E + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    return ex_sorted, starts
+
+
+def _per_example_features(train, coord):
+    """Per-example (x [n, p_max], flat entity idx [n]) for one random
+    effect — dense shards directly, sparse shards through the
+    (deterministic) subspace projection, per-bucket widths padded to
+    the coordinate's max width."""
+    grouping = coord.grouping
+    n = grouping.n_examples
+    n_ents = list(grouping.n_entities)
+    boff = np.zeros(len(n_ents), np.int64)
+    if len(n_ents) > 1:
+        boff[1:] = np.cumsum(n_ents)[:-1]
+    flat_idx = (boff[grouping.example_bucket]
+                + grouping.example_row).astype(np.int32)
+    # The estimator stamps feature_shard on the coordinate; direct
+    # callers fall back to probing the dataset's shards by kind.
+    shard = getattr(coord, "feature_shard", None)
+    if shard is None or shard not in train.features:
+        shard = _find_shard(train, coord,
+                            sparse=coord.projection is not None)
+    if coord.projection is None:
+        x = np.asarray(train.features[shard], np.float32)
+        widths = [x.shape[1]] * len(n_ents)
+        x_ex = x
+    else:
+        from photon_ml_tpu.data.sparse_rows import SparseRows
+        from photon_ml_tpu.game.projector import build_subspace_projection
+
+        rows = train.features[shard]
+        if not isinstance(rows, SparseRows):
+            rows = SparseRows.from_rows(rows)
+        _, x_blocks = build_subspace_projection(
+            grouping, rows, coord.projection.global_dim)
+        widths = [xb.shape[-1] for xb in x_blocks]
+        p_max = max(widths) if widths else 1
+        x_ex = np.zeros((n, p_max), np.float32)
+        for b in range(len(n_ents)):
+            sel = np.flatnonzero(grouping.example_bucket == b)
+            x_ex[sel, : widths[b]] = np.asarray(x_blocks[b])[
+                grouping.example_row[sel], grouping.example_col[sel]]
+    p_max = max(widths) if widths else 1
+    if x_ex.shape[1] < p_max:
+        x_ex = np.pad(x_ex, ((0, 0), (0, p_max - x_ex.shape[1])))
+    return (np.ascontiguousarray(x_ex, dtype=np.float32), flat_idx,
+            widths, boff, n_ents)
+
+
+def _find_shard(train, coord, sparse: bool = False) -> str:
+    """Feature shard the coordinate was built from.  Coordinates built
+    by the estimator don't carry their shard name; match by the
+    grouping's example count + dense/sparse kind.  Ambiguity is an
+    ERROR, not a guess: with a sparse fixed-effect shard AND a sparse
+    RE shard in the same dataset (every chunked workload), returning
+    the first sparse match could silently train the random effect on
+    the fixed effect's features — direct callers must pass
+    ``re_shards`` instead."""
+    n = coord.grouping.n_examples
+    candidates = []
+    for name, feats in train.features.items():
+        is_dense = isinstance(feats, np.ndarray)
+        if is_dense == sparse:
+            continue
+        if not hasattr(feats, "__len__") or len(feats) != n:
+            continue
+        candidates.append(name)
+    if len(candidates) == 1:
+        return candidates[0]
+    if not candidates:
+        raise ValueError("could not resolve the random effect's feature "
+                         "shard from the dataset")
+    raise ValueError(
+        f"ambiguous feature shard for random effect "
+        f"'{getattr(coord, 'name', '?')}': {sorted(candidates)} all "
+        f"match; pass re_shards= to build_fused_cycle_engine")
+
+
+def build_fused_cycle_engine(
+    train,
+    coords: dict,
+    update_sequence: list[str],
+    re_shards: dict[str, str] | None = None,
+    spill_dir: str | None = None,
+    host_max_resident: int = 2,
+    prefetch_depth: int = 2,
+    retirement: bool = True,
+    window_group=None,
+) -> FusedCycleEngine:
+    """Build the fused engine over already-built coordinates.
+
+    ``coords`` must contain exactly one ``ChunkedFixedEffectCoordinate``
+    in the update sequence (its chunk grid is the master grid) plus any
+    number of random-effect coordinates.  ``re_shards`` maps RE
+    coordinate name → feature shard name (the estimator knows; direct
+    callers may omit it and let the shard be probed).  With
+    ``spill_dir`` the sidecar chunks spill through the chunk store
+    (content-keyed — warm across runs); otherwise they stay resident.
+    """
+    from photon_ml_tpu.game.coordinates import ChunkedFixedEffectCoordinate
+
+    fe_name = None
+    re_names = []
+    for name in dict.fromkeys(update_sequence):
+        coord = coords[name]
+        if isinstance(coord, ChunkedFixedEffectCoordinate):
+            if fe_name is not None:
+                raise ValueError(
+                    "cd_fused supports exactly one chunked fixed-effect "
+                    "coordinate")
+            fe_name = name
+        else:
+            if not hasattr(coord, "grouping"):
+                raise ValueError(
+                    f"cd_fused: coordinate '{name}' is neither a "
+                    "chunked fixed effect nor a random effect")
+            re_names.append(name)
+    if fe_name is None:
+        raise ValueError("cd_fused requires a chunked fixed-effect "
+                         "coordinate (chunk_rows)")
+    fe_coord = coords[fe_name]
+    chunked = fe_coord.chunked
+    K, R = chunked.n_chunks, chunked.chunk_rows
+    n = chunked.n
+
+    res: list[_FusedRE] = []
+    side_planes: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+    for name in re_names:
+        coord = coords[name]
+        if coord.grouping.n_examples != n:
+            raise ValueError(
+                f"cd_fused: random effect '{name}' covers "
+                f"{coord.grouping.n_examples} examples, the fixed "
+                f"effect {n} — one chunk grid must fit both")
+        if (re_shards or {}).get(name):
+            coord.feature_shard = re_shards[name]
+        x_ex, flat_idx, widths, boff, n_ents = _per_example_features(
+            train, coord)
+        E_total = int(sum(n_ents))
+        ex_sorted, ent_starts = _flat_entity_runs(coord.grouping, boff)
+        lam = float(np.asarray(
+            coord.problem.objective.reg.l2_weight)) if hasattr(
+                coord.problem.objective.reg, "l2_weight") else 0.0
+        tol = float(coord.problem.config.tolerance)
+        res.append(_FusedRE(
+            name=name, coord=coord, lam=lam, tolerance=tol,
+            widths=[int(w) for w in widths],
+            p_max=max(widths) if widths else 1,
+            n_entities=[int(e) for e in n_ents],
+            boff=boff, E_total=E_total,
+            ex_sorted=ex_sorted, ent_starts=ent_starts,
+            active=np.ones(E_total, bool),
+        ))
+        side_planes[name] = (x_ex, flat_idx)
+
+    e_totals = {r.name: r.E_total for r in res}
+
+    def _planes() -> dict:
+        """The per-example feature planes, re-materialized from the
+        dataset + coordinates on demand: once every sidecar chunk is
+        spilled, the planes are DROPPED (a projected sparse RE's dense
+        [n, p_max] plane is the whole point of spilling — keeping it
+        closed over by the store's rebuild hook would pin it for the
+        engine's lifetime and void the window bound); a corrupt/missing
+        chunk rebuild pays one deterministic re-projection instead."""
+        if not side_planes:
+            for r in res:
+                x_ex, flat_idx, *_ = _per_example_features(train, r.coord)
+                side_planes[r.name] = (x_ex, flat_idx)
+        return side_planes
+
+    def build_sidecar(i: int) -> dict:
+        lo = i * R
+        hi = min(lo + R, n)
+        out: dict = {}
+        for name, (x_ex, flat_idx) in _planes().items():
+            E_total = e_totals[name]
+            x = x_ex[lo:hi]
+            if hi - lo < R:
+                x = np.pad(x, ((0, R - (hi - lo)), (0, 0)))
+            idx = np.full(R, E_total, np.int32)
+            idx[: hi - lo] = flat_idx[lo:hi]
+            out[name + ".x"] = np.ascontiguousarray(x)
+            out[name + ".idx"] = idx
+        return out
+
+    sidecar_store = None
+    sidecar_resident = None
+    if res and spill_dir is not None:
+        from photon_ml_tpu.data.chunk_store import (
+            FUSED_CHUNK_CODEC,
+            ChunkStore,
+            array_content_key,
+            probe_spill_dir,
+            release_free_heap,
+        )
+
+        if probe_spill_dir(spill_dir) is not None:
+            key_arrays = []
+            for name in sorted(side_planes):
+                key_arrays.extend(side_planes[name])
+            key = array_content_key(key_arrays, {
+                "kind": "fused-sidecar", "chunk_rows": int(R),
+                "n_chunks": int(K),
+                "res": sorted(side_planes),
+            })
+            sidecar_store = ChunkStore(
+                spill_dir, key, K,
+                host_max_resident=host_max_resident,
+                rebuild=build_sidecar, codec=FUSED_CHUNK_CODEC,
+                window_group=window_group)
+            missing = [i for i in range(K) if not sidecar_store.has(i)]
+            for i in missing:
+                sidecar_store.put(i, build_sidecar(i))
+            # Spilled: drop the materialized planes (see ``_planes``) —
+            # the store's LRU window is now the only sidecar residency.
+            side_planes.clear()
+            if missing:
+                release_free_heap()
+            logger.info(
+                "fused sidecar: %d chunks (%d built, %d reused) "
+                "spilled to %s", K, len(missing), K - len(missing),
+                spill_dir)
+    if res and sidecar_store is None:
+        sidecar_resident = [build_sidecar(i) for i in range(K)]
+
+    engine = FusedCycleEngine(
+        fe_name=fe_name, fe_coord=fe_coord, res=res, n_examples=n,
+        prefetch_depth=prefetch_depth, retirement=retirement,
+        sidecar_store=sidecar_store, sidecar_resident=sidecar_resident)
+    logger.info(
+        "fused CD engine: fixed effect '%s' (%d chunks × %d rows) + "
+        "%d random effect(s) %s — one store pass per cycle", fe_name,
+        K, R, len(res), [r.name for r in res])
+    return engine

@@ -58,7 +58,8 @@ MODES = ("off", "metrics", "trace")
 # Span names that represent one full streamed data pass — the basis for
 # the prefetcher overlap-efficiency derivation (consumer blocked time /
 # total streamed pass time).
-PASS_SPANS = ("sweep", "per_example_pass", "score_pass", "re_sweep")
+PASS_SPANS = ("sweep", "per_example_pass", "score_pass", "re_sweep",
+              "fused_cycle_pass")
 
 # Bounded-reservoir cap for histograms and sampled gauges: when full,
 # the reservoir decimates to every-other sample and doubles its stride

@@ -60,6 +60,12 @@ METRICS: tuple[tuple[str, str, str], ...] = (
     ("re", "re.streamed.rows_per_sec", "higher"),
     ("re", "re.sweep_time_ratio", "lower"),
     ("re", "re.retirement_work_fraction", "lower"),
+    # Fused CD super-sweep (ISSUE 11): one store pass per cycle is THE
+    # claim — passes/cycle creeping up, the fused pass slowing against
+    # the legacy pass, or fused throughput dropping all gate.
+    ("cd_fused", "cd_fused.passes_per_cycle_fused", "lower"),
+    ("cd_fused", "cd_fused.pass_time_ratio", "lower"),
+    ("cd_fused", "cd_fused.fused.rows_per_sec", "higher"),
 )
 
 

@@ -132,11 +132,19 @@ def _convergence(events: list[dict], counters: dict) -> dict | None:
     ls = counters.get("solver.ls_trials", 0)
     grad_rec = counters.get("solver.grad_recovery_sweeps", 0)
     aux = counters.get("solver.aux_sweeps", 0)
+    fused = counters.get("solver.fused_cycle_sweeps", 0)
     if (not iters_by_solver and not traces and not re_by_coord
             and sweeps is None):
         return None
-    expected = solves + ls + grad_rec + aux
+    expected = solves + ls + grad_rec + aux + fused
     unattributed = (sweeps or 0) - expected
+    # Data passes per CD cycle (ISSUE 11): the fused super-sweep's
+    # deliverable is this ratio dropping from ~C (coordinates × solver
+    # iterations) to ~1 (one fused pass per cycle + the final score
+    # pass).  None when the run had no CD loop (plain solver benches).
+    cycles = counters.get("cd.cycles", 0)
+    passes_per_cycle = (round((sweeps or 0) / cycles, 3) if cycles
+                        else None)
     iter_events = sum(iters_by_solver.values())
     ok = unattributed >= 0
     if solves:
@@ -151,7 +159,10 @@ def _convergence(events: list[dict], counters: dict) -> dict | None:
         "ls_trials": ls,
         "grad_recovery_sweeps": grad_rec,
         "aux_sweeps": aux,
+        "fused_cycle_sweeps": fused,
         "unattributed_sweeps": unattributed,
+        "cd_cycles": cycles,
+        "passes_per_cycle": passes_per_cycle,
         "iterations": {f"{s}:{lbl}" if lbl else s: n
                        for (s, lbl), n in sorted(iters_by_solver.items())},
         "iteration_events": iter_events,
@@ -347,8 +358,13 @@ def report(path: str, threshold: float = 0.9, out=None) -> dict:
           f"{conv['ls_trials']} ls trials + "
           f"{conv['grad_recovery_sweeps']} grad recoveries + "
           f"{conv['aux_sweeps']} aux + "
+          f"{conv['fused_cycle_sweeps']} fused cycles + "
           f"{conv['unattributed_sweeps']} unattributed "
           f"-> {'PASS' if conv['ok'] else 'FAIL'}")
+        if conv["passes_per_cycle"] is not None:
+            w(f"  passes/cycle: {conv['passes_per_cycle']} "
+              f"({conv['sweeps']} passes / {conv['cd_cycles']} CD "
+              "cycles)")
         w()
 
     device = _device(summary)

@@ -1,0 +1,616 @@
+"""Fused CD super-sweep (ISSUE 11): one streamed store pass per
+coordinate-descent cycle must converge to the same block-stationary
+point as the per-coordinate path — coefficients, scores, and final
+validation metric — across coordinate mixes (fixed-only, fixed + dense
+RE, fixed + sparse/projected RE, with retirement) × chunk grids; the
+sweep odometer must attribute every pass (passes/cycle ≈ 1 through
+``telemetry report``); checkpoint/resume at cycle boundaries must
+restore to parity; warm fused sweeps must compile nothing; the
+``train.cd_fused`` monitor stage must emit per-chunk progress; and the
+shared LRU window must bound TOTAL residency across coordinates in the
+legacy path too.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.config import (
+    CoordinateConfig,
+    CoordinateKind,
+    OptimizerSettings,
+    TrainingConfig,
+)
+from photon_ml_tpu.estimators.game_estimator import GameEstimator
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.models.glm import TaskType
+
+# The documented fused-vs-per-coordinate tolerance (README "Fused CD
+# training"): both paths stop within solver tolerance of the same
+# block-stationary point, not bitwise-identically — the fused path
+# walks damped Jacobi Newton steps, the legacy path full inner solves.
+PARITY_ATOL = 5e-3
+
+
+def _workload(rng, n=360, d=30, k=4, d_re=2, re_kind="dense"):
+    """Sparse fixed-effect shard + optional random effect (dense or
+    sparse/projected), labels driven by both planes."""
+    cols = np.stack([np.sort(rng.choice(d, k, replace=False))
+                     for _ in range(n)]).astype(np.int32)
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    w_true = rng.normal(0, 1, d)
+    ids = np.concatenate([
+        rng.integers(0, 20, (2 * n) // 3),
+        rng.integers(100, 104, n - (2 * n) // 3),
+    ])
+    b_true = rng.normal(0, 0.7, 200)
+    m = np.einsum("nk,nk->n", vals, w_true[cols]) + b_true[ids % 200]
+    y = (m + rng.normal(0, 0.3, n) > 0).astype(np.float32)
+    rows = [(cols[i], vals[i]) for i in range(n)]
+    features = {"f": rows}
+    feature_dims = {"f": d}
+    if re_kind == "dense":
+        features["re"] = rng.normal(0, 1, (n, d_re)).astype(np.float32)
+    elif re_kind == "sparse":
+        d_sp = 10
+        re_rows = []
+        for _ in range(n):
+            kk = rng.integers(1, 4)
+            rc = rng.choice(d_sp, size=kk, replace=False).astype(np.int32)
+            re_rows.append((rc, rng.normal(0, 1, kk).astype(np.float32)))
+        features["re"] = re_rows
+        feature_dims["re"] = d_sp
+    entity_ids = {} if re_kind == "none" else {"u": ids}
+    return GameDataset(labels=y, features=features,
+                       entity_ids=entity_ids, feature_dims=feature_dims)
+
+
+def _cfg(fused, iters, re=True, chunk_rows=96, tolerance=1e-6, **kw):
+    coords = [CoordinateConfig(
+        name="global", kind=CoordinateKind.FIXED_EFFECT,
+        feature_shard="f",
+        optimizer=OptimizerSettings(max_iters=60, reg_weight=1.0,
+                                    tolerance=tolerance))]
+    seq = ["global"]
+    if re:
+        coords.append(CoordinateConfig(
+            name="per_u", kind=CoordinateKind.RANDOM_EFFECT,
+            feature_shard="re", entity_key="u",
+            optimizer=OptimizerSettings(max_iters=40, reg_weight=2.0,
+                                        tolerance=tolerance)))
+        seq.append("per_u")
+    kw.setdefault("validation_fraction", 0.0)
+    kw.setdefault("validate_per_iteration", False)
+    cfg = TrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinates=coords, update_sequence=seq, n_iterations=iters,
+        intercept=False, chunk_rows=chunk_rows, chunk_layout="ELL",
+        cd_fused=fused, **kw)
+    cfg.validate()
+    return cfg
+
+
+def _fe(models):
+    return np.asarray(models["global"].coefficients.means)
+
+
+def _re_blocks(models):
+    return [np.asarray(b) for b in models["per_u"].coefficient_blocks]
+
+
+def _assert_model_parity(m_a, m_b, atol=PARITY_ATOL):
+    np.testing.assert_allclose(_fe(m_a), _fe(m_b), atol=atol, rtol=0)
+    if "per_u" in m_a:
+        for ba, bb in zip(_re_blocks(m_a), _re_blocks(m_b)):
+            np.testing.assert_allclose(ba, bb, atol=atol, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# Fused ≡ per-coordinate parity across coordinate mixes × chunk grids
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("re_kind,chunk_rows", [
+    ("none", 96), ("dense", 96), ("dense", 64), ("sparse", 96),
+])
+def test_fused_matches_percoord(rng, re_kind, chunk_rows):
+    """The documented-tolerance parity matrix: final coefficients agree
+    across fixed-only / fixed+dense-RE / fixed+sparse-projected-RE ×
+    chunk grids (the fused path runs more, cheaper cycles)."""
+    ds = _workload(rng, re_kind=re_kind)
+    re = re_kind != "none"
+    m_l = GameEstimator(_cfg(False, 3, re=re, chunk_rows=chunk_rows)
+                        ).fit(ds)[0].model.models
+    m_f = GameEstimator(_cfg(True, 80, re=re, chunk_rows=chunk_rows)
+                        ).fit(ds)[0].model.models
+    _assert_model_parity(m_l, m_f)
+
+
+def test_fused_scores_match_percoord(rng):
+    """Score parity one level deeper than coefficients: the two fits'
+    models transform identically (within the documented tolerance) on
+    the training data."""
+    from photon_ml_tpu.estimators import GameTransformer
+
+    ds = _workload(rng)
+    r_l = GameEstimator(_cfg(False, 4)).fit(ds)[0]
+    r_f = GameEstimator(_cfg(True, 80)).fit(ds)[0]
+    s_l = np.asarray(GameTransformer(
+        model=r_l.model, task=TaskType.LOGISTIC_REGRESSION).transform(ds))
+    s_f = np.asarray(GameTransformer(
+        model=r_f.model, task=TaskType.LOGISTIC_REGRESSION).transform(ds))
+    np.testing.assert_allclose(s_f, s_l, atol=1e-2, rtol=0)
+
+
+def test_fused_validation_trajectory(rng):
+    """Per-cycle validation rides the fused loop like the legacy one:
+    one entry per cycle, and both paths end at the same metric."""
+    from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+
+    ds = _workload(rng, n=420)
+    val = _workload(np.random.default_rng(7), n=200)
+    kw = dict(validate_per_iteration=True,
+              evaluators=[EvaluatorType.AUC])
+    r_l = GameEstimator(_cfg(False, 3, **kw)).fit(ds, val)[0]
+    r_f = GameEstimator(_cfg(True, 60, **kw)).fit(ds, val)[0]
+    assert len(r_f.validation_history) == 60
+    auc_l = r_l.evaluations[EvaluatorType.AUC]
+    auc_f = r_f.evaluations[EvaluatorType.AUC]
+    assert abs(auc_l - auc_f) < 0.02
+    # The fused trajectory improves (first → best) like a descent.
+    first = r_f.validation_history[0][EvaluatorType.AUC]
+    assert auc_f >= first - 1e-6
+
+
+def test_fused_retirement_equivalent_and_active(rng, tmp_path):
+    """Retirement gates per-entity Gram accumulation without moving
+    the final model beyond tolerance, and actually retires entities on
+    a converging fit (the PR 5 semantics on the fused path)."""
+    from photon_ml_tpu.utils.run_log import RunLogger, read_run_log
+
+    ds = _workload(rng)
+    kw = dict(tolerance=1e-4)
+
+    def run(retirement, tag):
+        log_path = str(tmp_path / f"log_{tag}.jsonl")
+        with RunLogger(log_path) as log:
+            r = GameEstimator(_cfg(True, 80, re_retirement=retirement,
+                                   **kw)).fit(ds, run_logger=log)[0]
+        cycles = [e for e in read_run_log(log_path)
+                  if e.get("event") == "cd_fused_cycle"]
+        return r, cycles
+
+    r_on, cyc_on = run(True, "on")
+    r_off, cyc_off = run(False, "off")
+    _assert_model_parity(r_on.model.models, r_off.model.models,
+                         atol=1e-2)
+    assert max(e["entities_retired"] for e in cyc_on) > 0, \
+        "no entity ever retired on a converging fit"
+    assert all(e["entities_retired"] == 0 for e in cyc_off)
+
+
+def test_fused_spilled_matches_resident_sidecars(rng, tmp_path):
+    """Sidecar chunks through the content-keyed chunk store (spill_dir)
+    ≡ resident sidecars, and the second fit reuses the spilled files
+    (warm across runs)."""
+    import glob
+    import os
+
+    ds = _workload(rng)
+    m_res = GameEstimator(_cfg(True, 40)).fit(ds)[0].model.models
+    cfg = _cfg(True, 40, spill_dir=str(tmp_path), host_max_resident=2)
+    est = GameEstimator(cfg)
+    m_sp = est.fit(ds)[0].model.models
+    _assert_model_parity(m_res, m_sp, atol=1e-6)
+    # FE chunks and sidecar chunks share ONE host_max_resident budget
+    # (third review round: per-store windows doubled the documented
+    # bound in exactly this shape).
+    group = est._chunk_window_group
+    assert group is not None and group.budget == 2
+    assert group.n_resident <= 2
+    files = glob.glob(str(tmp_path / "chunks" / "*.npz"))
+    assert files, "no sidecar chunks spilled"
+    mtimes = {f: os.path.getmtime(f) for f in files}
+    m_sp2 = GameEstimator(cfg).fit(ds)[0].model.models
+    _assert_model_parity(m_sp, m_sp2, atol=0)
+    assert {f: os.path.getmtime(f) for f in files} == mtimes, \
+        "warm fit re-spilled sidecar chunks"
+
+
+# ---------------------------------------------------------------------------
+# Odometer accounting + passes/cycle through telemetry report
+# ---------------------------------------------------------------------------
+
+
+def test_fused_odometer_and_passes_per_cycle(rng, tmp_path, capsys):
+    """The fused extension of the sweep-odometer identity: every data
+    pass is claimed (cycles by ``solver.fused_cycle_sweeps``, the final
+    score pass by ``solver.aux_sweeps``), ``telemetry report`` holds rc
+    0, and ``passes_per_cycle`` ≈ 1 lands in its JSON and Convergence
+    table — the ISSUE 11 deliverable as a first-class metric."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+    from photon_ml_tpu.utils.run_log import RunLogger
+
+    ds = _workload(rng)
+    log_path = str(tmp_path / "run_log.jsonl")
+    log = RunLogger(log_path)
+    t = telemetry.start("metrics", run_logger=log)
+    try:
+        GameEstimator(_cfg(True, 10)).fit(ds, run_logger=log)
+        summary = t.summary()
+    finally:
+        t.close()
+        log.close()
+    c = summary["counters"]
+    # The raw identity: N cycle passes + 1 final score pass, no
+    # unattributed sweeps, one pass per cycle plus the epilogue.
+    assert c["solver.fused_cycle_sweeps"] == 10
+    assert c["solver.aux_sweeps"] == 1
+    assert c["cd.cycles"] == 10
+    assert c["solver.sweeps"] == (c["solver.fused_cycle_sweeps"]
+                                  + c["solver.aux_sweeps"])
+    rc = telemetry_main(["report", log_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "passes/cycle" in out and "PASS" in out
+    tail = json.loads(out.strip().splitlines()[-1])
+    conv = tail["convergence"]
+    assert conv["ok"] is True
+    assert conv["unattributed_sweeps"] == 0
+    assert conv["fused_cycle_sweeps"] == 10
+    assert conv["cd_cycles"] == 10
+    assert conv["passes_per_cycle"] == pytest.approx(1.1)
+
+
+def test_legacy_report_passes_per_cycle_counts_c(rng, tmp_path, capsys):
+    """The same metric on the per-coordinate path reports the C× pass
+    bill the fused path removes (and the identity still holds)."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+    from photon_ml_tpu.utils.run_log import RunLogger
+
+    ds = _workload(rng)
+    log_path = str(tmp_path / "run_log.jsonl")
+    log = RunLogger(log_path)
+    t = telemetry.start("metrics", run_logger=log)
+    try:
+        GameEstimator(_cfg(False, 2)).fit(ds, run_logger=log)
+    finally:
+        t.close()
+        log.close()
+    rc = telemetry_main(["report", log_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    conv = json.loads(out.strip().splitlines()[-1])["convergence"]
+    assert conv["ok"] is True
+    assert conv["cd_cycles"] == 2
+    # Each cycle pays the fixed effect's full inner solve (multiple
+    # passes: solve init + line-search trials + grad recoveries).
+    assert conv["passes_per_cycle"] > 2.0
+
+
+def test_training_driver_cd_fused_e2e(rng, tmp_path, capsys):
+    """The acceptance criterion end to end: `--cd-fused on` through the
+    training driver, then `telemetry report` over the run log shows
+    passes/cycle ≈ 1 with the odometer identity holding (rc 0)."""
+    from photon_ml_tpu.cli import game_training_driver
+    from photon_ml_tpu.io.libsvm import write_libsvm
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+    from photon_ml_tpu.utils.synthetic import make_a1a_like
+
+    rows, labels, _ = make_a1a_like(n=600, seed=5)
+    train_path = str(tmp_path / "a1a.libsvm")
+    write_libsvm(train_path, rows, np.where(labels > 0, 1, -1))
+    config = {
+        "task_type": "LOGISTIC_REGRESSION",
+        "coordinates": [{
+            "name": "global", "kind": "FIXED_EFFECT",
+            "feature_shard": "features",
+            "optimizer": {"optimizer": "LBFGS", "reg_weight": 1.0,
+                          "max_iters": 60},
+        }],
+        "update_sequence": ["global"],
+        "n_iterations": 20,
+        "input_path": train_path,
+        "output_dir": str(tmp_path / "out"),
+        "chunk_rows": 200,
+        "chunk_layout": "ELL",
+        "intercept": False,
+        "validation_fraction": 0.0,
+    }
+    cfg_path = str(tmp_path / "cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(config, f)
+    game_training_driver.main(["--config", cfg_path,
+                               "--cd-fused", "on",
+                               "--telemetry", "metrics"])
+    log_path = str(tmp_path / "out" / "run_log.jsonl")
+    rc = telemetry_main(["report", log_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    conv = json.loads(out.strip().splitlines()[-1])["convergence"]
+    assert conv["ok"] is True
+    assert conv["unattributed_sweeps"] == 0
+    assert conv["cd_cycles"] == 20
+    assert conv["passes_per_cycle"] <= 1.1
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / resume at fused-cycle boundaries (PR 9 granularities)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_checkpoint_resume_parity(rng, tmp_path):
+    """Kill-free equivalent of the SIGKILL e2e: 3 checkpointed cycles,
+    then a --resume run to 8 total, must land where the uninterrupted
+    8-cycle run lands (the engine's alpha/prev-value/retirement state
+    rides re_state['__cd_fused__'])."""
+    ds = _workload(rng)
+    full = GameEstimator(_cfg(True, 8, tolerance=1e-4)
+                         ).fit(ds)[0].model.models
+
+    ck = str(tmp_path / "ckpt")
+    GameEstimator(_cfg(True, 3, tolerance=1e-4, checkpoint_dir=ck)
+                  ).fit(ds)
+    resumed = GameEstimator(
+        _cfg(True, 8, tolerance=1e-4, checkpoint_dir=ck, resume=True)
+    ).fit(ds)[0].model.models
+    _assert_model_parity(full, resumed, atol=1e-5)
+
+
+def test_fused_checkpoint_refuses_cross_mode_resume(rng, tmp_path):
+    """A fused checkpoint pairs post-step coefficients with cycle-start
+    score planes; resuming it with cd_fused OFF would train every
+    coordinate against one-step-stale offsets — the loop refuses
+    instead (review finding)."""
+    ds = _workload(rng)
+    ck = str(tmp_path / "ckpt")
+    GameEstimator(_cfg(True, 3, checkpoint_dir=ck)).fit(ds)
+    with pytest.raises(ValueError, match="fused"):
+        GameEstimator(_cfg(False, 6, checkpoint_dir=ck,
+                           resume=True)).fit(ds)
+
+
+def test_legacy_checkpoint_refuses_fused_resume(rng, tmp_path):
+    """The symmetric direction (second review round): a legacy
+    checkpoint's iteration budget means FULL inner solves — adopting
+    it as a fused start would 'complete' under-converged silently."""
+    ds = _workload(rng)
+    ck = str(tmp_path / "ckpt")
+    GameEstimator(_cfg(False, 2, checkpoint_dir=ck)).fit(ds)
+    with pytest.raises(ValueError, match="per-coordinate"):
+        GameEstimator(_cfg(True, 40, checkpoint_dir=ck,
+                           resume=True)).fit(ds)
+
+
+def test_fused_resume_rejects_config_edit(rng, tmp_path):
+    """The engine snapshot carries a config-identity fingerprint
+    (second review round, the PR 9 solver-snapshot rule): resuming
+    after a regularization edit must reject the stale retirement /
+    step-scale state instead of adopting it."""
+    ds = _workload(rng)
+    ck = str(tmp_path / "ckpt")
+    GameEstimator(_cfg(True, 3, checkpoint_dir=ck)).fit(ds)
+    edited = _cfg(True, 6, checkpoint_dir=ck, resume=True)
+    edited.coordinates[1].optimizer.reg_weight = 50.0
+    with pytest.raises(ValueError, match="different configuration"):
+        GameEstimator(edited).fit(ds)
+
+
+def test_fused_resume_rejects_retirement_flip(rng, tmp_path):
+    """Retirement mode is part of the snapshot's identity (third
+    review round): a mask frozen under retirement=True adopted by a
+    retirement=False run would gate those entities off forever — the
+    wake branch is skipped when retirement is off."""
+    ds = _workload(rng)
+    ck = str(tmp_path / "ckpt")
+    GameEstimator(_cfg(True, 3, tolerance=1e-4, checkpoint_dir=ck,
+                       re_retirement=True)).fit(ds)
+    with pytest.raises(ValueError, match="different configuration"):
+        GameEstimator(_cfg(True, 6, tolerance=1e-4, checkpoint_dir=ck,
+                           resume=True, re_retirement=False)).fit(ds)
+
+
+@pytest.mark.fast
+def test_find_shard_ambiguity_is_an_error(rng):
+    """Direct-caller shard probing must refuse to guess between two
+    same-kind same-length shards (second review round: the first
+    sparse match could be the FIXED EFFECT's shard)."""
+    from photon_ml_tpu.game.fused_sweep import _find_shard
+
+    n = 40
+    rows_a = [(np.array([0], np.int32), np.ones(1, np.float32))
+              for _ in range(n)]
+    rows_b = [(np.array([1], np.int32), np.ones(1, np.float32))
+              for _ in range(n)]
+    ds = GameDataset(labels=np.zeros(n, np.float32),
+                     features={"fe": rows_a, "re": rows_b},
+                     entity_ids={"u": np.zeros(n, np.int64)},
+                     feature_dims={"fe": 4, "re": 4})
+
+    class _Coord:
+        name = "per_u"
+
+        class grouping:
+            n_examples = n
+
+    with pytest.raises(ValueError, match="ambiguous"):
+        _find_shard(ds, _Coord, sparse=True)
+
+
+@pytest.mark.fast
+def test_re_step_retirement_movement_is_undamped():
+    """The retirement movement plane is the FULL Newton step's norm,
+    not the α-damped step applied: at α = 1/64 a still-moving entity
+    must not read as converged (review finding — the damped gate
+    loosened the effective threshold to tolerance/α)."""
+    from photon_ml_tpu.game.fused_sweep import _re_step
+
+    tab = jnp.zeros((3, 2), jnp.float32)
+    g = jnp.ones((3, 2), jnp.float32) * 0.1
+    G = jnp.tile(jnp.eye(2, dtype=jnp.float32), (3, 1, 1))
+    active = jnp.ones(3, jnp.float32)
+    _, move_full = _re_step(tab, g, G, active, 0.0, 1.0)
+    tab_d, move_damped = _re_step(tab, g, G, active, 0.0, 1.0 / 64)
+    np.testing.assert_allclose(np.asarray(move_damped),
+                               np.asarray(move_full), rtol=1e-6)
+    # ...while the APPLIED step is still damped.
+    assert float(jnp.max(jnp.abs(tab_d))) < float(move_full[0])
+
+
+# ---------------------------------------------------------------------------
+# Compile budget + monitor stage
+# ---------------------------------------------------------------------------
+
+
+def test_fused_zero_new_compiles_after_warmup(rng):
+    """Warm fused sweeps replay module-level jitted programs: a second
+    fit (same shapes) compiles NOTHING — the guard-pinned acceptance
+    criterion."""
+    from photon_ml_tpu.analysis.guards import count_compiles
+
+    ds = _workload(rng)
+    cfg = _cfg(True, 4)
+    GameEstimator(cfg).fit(ds)                      # warmup
+    with count_compiles() as log:
+        GameEstimator(cfg).fit(ds)
+    assert log.count == 0, [r.name for r in log.records]
+
+
+def test_fused_monitor_progress_stage(rng, tmp_path):
+    """The ``train.cd_fused`` monitor stage (ISSUE 11 satellite): a
+    monitored fused fit emits per-chunk progress snapshots whose final
+    snapshot per cycle reads done == total == n_chunks, so ``telemetry
+    watch`` and /status show fused-cycle progress like every other
+    instrumented loop."""
+    from photon_ml_tpu.utils.run_log import RunLogger, read_run_log
+
+    ds = _workload(rng)
+    log_path = str(tmp_path / "run_log.jsonl")
+    with RunLogger(log_path) as log:
+        GameEstimator(_cfg(True, 3, monitor="on",
+                           monitor_every_s=0.001)).fit(ds, run_logger=log)
+    events = read_run_log(log_path)
+    fused = [e for e in events if e.get("event") == "progress"
+             and e.get("stage") == "train.cd_fused"]
+    assert fused, "no train.cd_fused progress events"
+    n_chunks = -(-ds.n // 96)
+    assert any(e["done"] == e.get("total") == n_chunks for e in fused)
+    assert all(e["unit"] == "chunks" for e in fused)
+    # The CD loop's cycle-level stage rides alongside.
+    cd = [e for e in events if e.get("event") == "progress"
+          and e.get("stage") == "cd"]
+    assert any(e.get("unit") == "cycles" for e in cd)
+
+
+# ---------------------------------------------------------------------------
+# Config validation + shared LRU window (legacy-path satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_cd_fused_config_validation():
+    base = dict(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinates=[CoordinateConfig(
+            name="g", kind=CoordinateKind.FIXED_EFFECT,
+            feature_shard="f", optimizer=OptimizerSettings())],
+        update_sequence=["g"],
+    )
+    with pytest.raises(ValueError, match="chunk_rows"):
+        TrainingConfig(cd_fused=True, **base).validate()
+    with pytest.raises(ValueError, match="locked"):
+        TrainingConfig(cd_fused=True, chunk_rows=100,
+                       locked_coordinates=["g"],
+                       warm_start_model_dir="/tmp/m", **base).validate()
+    with pytest.raises(ValueError, match="single-device"):
+        TrainingConfig(cd_fused=True, chunk_rows=100, n_devices=2,
+                       **base).validate()
+    two_fe = dict(base)
+    two_fe["coordinates"] = base["coordinates"] + [CoordinateConfig(
+        name="g2", kind=CoordinateKind.FIXED_EFFECT, feature_shard="f2",
+        optimizer=OptimizerSettings())]
+    two_fe["update_sequence"] = ["g", "g2"]
+    with pytest.raises(ValueError, match="exactly one fixed-effect"):
+        TrainingConfig(cd_fused=True, chunk_rows=100,
+                       **two_fe).validate()
+    from photon_ml_tpu.ops.regularization import RegularizationType
+
+    l1 = dict(base)
+    l1["coordinates"] = [CoordinateConfig(
+        name="g", kind=CoordinateKind.FIXED_EFFECT, feature_shard="f",
+        optimizer=OptimizerSettings(
+            regularization=RegularizationType.L1))]
+    with pytest.raises(ValueError, match="smooth regularization"):
+        TrainingConfig(cd_fused=True, chunk_rows=100, **l1).validate()
+    TrainingConfig(cd_fused=True, chunk_rows=100, **base).validate()
+    # JSON round trip carries the knob.
+    from photon_ml_tpu.config import (
+        config_to_json,
+        training_config_from_json,
+    )
+
+    cfg = TrainingConfig(cd_fused=True, chunk_rows=100, **base)
+    assert training_config_from_json(config_to_json(cfg)).cd_fused is True
+
+
+@pytest.mark.fast
+def test_shared_chunk_window_bounds_total_residency(tmp_path):
+    """SharedChunkWindow unit contract: the budget bounds the SUM of
+    resident chunks across member stores; eviction takes the globally
+    least-recently-used chunk whichever store owns it."""
+    from photon_ml_tpu.data.chunk_store import (
+        ChunkStore,
+        SharedChunkWindow,
+        encode_array_chunk,
+        decode_array_chunk,
+    )
+
+    codec = (encode_array_chunk, decode_array_chunk)
+    group = SharedChunkWindow(2)
+    stores = [ChunkStore(str(tmp_path), f"k{j}", 4, host_max_resident=4,
+                         codec=codec, window_group=group)
+              for j in range(2)]
+    for j, store in enumerate(stores):
+        for i in range(4):
+            store.put(i, {"a": np.full(8, 10 * j + i, np.float32)},
+                      keep_resident=False)
+    # Interleaved access: the group, not the per-store window, governs.
+    for i in range(4):
+        for store in stores:
+            store.get(i)
+            total = sum(s.n_resident for s in stores)
+            assert total <= 2, f"group budget violated: {total}"
+    assert group.evictions > 0
+    # LRU across stores: after touching (s0, 3) then (s1, 3), loading a
+    # fresh chunk into s0 evicts the group-oldest — (s0, 3) stays if
+    # touched last... touch s0's chunk, then load into s1: s1's OLD
+    # chunk is the victim, not s0's fresh one.
+    stores[0].get(3)
+    stores[1].get(0)
+    stores[0].get(3)                      # touch → most recent
+    stores[1].get(1)                      # evicts (s1, 0), not (s0, 3)
+    assert 3 in stores[0]._resident
+    # join/leave bookkeeping: dropping a store forgets its entries.
+    stores[0].drop_resident()
+    assert stores[0].n_resident == 0
+    assert group.n_resident == sum(s.n_resident for s in stores)
+
+
+def test_estimator_shares_window_across_coordinates(rng, tmp_path):
+    """Legacy-path satellite e2e: with a chunked fixed effect AND a
+    streamed random effect both spilling, the estimator groups their
+    stores under ONE host_max_resident budget — the per-coordinate
+    descent no longer pins (window × coordinates) chunks."""
+    ds = _workload(rng)
+    cfg = _cfg(False, 2, spill_dir=str(tmp_path), host_max_resident=2,
+               re_chunk_entities=6)
+    est = GameEstimator(cfg)
+    est.fit(ds)
+    group = est._chunk_window_group
+    assert group is not None
+    assert group.budget == 2
+    assert group.n_resident <= 2
