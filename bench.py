@@ -77,7 +77,8 @@ V5E_PEAK_GBPS = PLATFORM_PEAK_GBPS["tpu"][0]
 
 DEFAULT_SECTIONS = ("etl", "cached", "grr", "segment_sum", "colmajor")
 ALL_SECTIONS = DEFAULT_SECTIONS + ("powerlaw", "chunked", "sweep",
-                                   "stream", "score", "re", "cd_fused")
+                                   "stream", "score", "re", "cd_fused",
+                                   "serve")
 DEFAULT_BUDGET_S = 840.0
 DEFAULT_N, DEFAULT_D, DEFAULT_K = 1_000_000, 100_000, 30
 
@@ -134,6 +135,22 @@ CDF_LEGACY_ITERS = 4
 CDF_LEGACY_MAX_ITERS = 15
 CDF_D_RE = 4
 
+# Serve section shape (ISSUE 12): a subprocess-isolated model server
+# (honest per-process RSS, real socket path) under SERVE_CLIENTS
+# concurrent OPEN-LOOP clients — each fires on its own fixed schedule
+# regardless of completions, so queueing delay lands IN the measured
+# latency instead of throttling the offered load (the closed-loop
+# trap).  The request pool replays real dataset rows with every 7th
+# entity id remapped to an unseen one (the fixed-effect fallback path
+# stays on the measured path).
+SERVE_CLIENTS = 4
+SERVE_ROWS_PER_REQ = 8
+SERVE_REQS_PER_CLIENT = 100      # measured requests per client
+SERVE_WARM_REQS = 8              # per client, before the clock starts
+SERVE_INTERVAL_S = 0.010         # open-loop firing cadence per client
+SERVE_POOL = 512                 # distinct request rows replayed
+SERVE_BATCH_ROWS = 64            # largest micro-batch bucket
+
 # Per-section wall-clock estimates at the FULL bench shape on the
 # measured host (BENCH_r05 tail: etl 123 s, grr measure 346 s, colmajor
 # 305 s, segment_sum 35 s; powerlaw/chunked from the r05 PERF record),
@@ -163,6 +180,10 @@ SECTION_EST_S = {
     # Two subprocess arms × (chunk ETL + a warm-up fit + the measured
     # fit: CDF_FUSED_CYCLES+1 passes fused, ~C×iters passes legacy).
     "cd_fused": 480.0,
+    # One server subprocess (model load + bucket warm-up) + the
+    # open-loop client storm (~CLIENTS × REQS × INTERVAL of wall) +
+    # the parent's parity pass over the request pool.
+    "serve": 240.0,
 }
 
 
@@ -1748,6 +1769,197 @@ def section_cd_fused(ctx: BenchContext) -> None:
           file=sys.stderr)
 
 
+def section_serve(ctx: BenchContext) -> None:
+    """Online serving (ISSUE 12 tentpole measurement): a subprocess-
+    isolated model server under SERVE_CLIENTS concurrent open-loop
+    clients.  Claims under test: served margins match the batch scorer
+    on the identical rows, client-observed p50/p99 latency and
+    sustained rows/s under concurrency, micro-batch fill, and the
+    server's own peak RSS — all from the real socket path."""
+    import shutil
+    import signal
+    import subprocess
+    import threading
+    import urllib.request
+
+    from photon_ml_tpu.estimators.streaming_scorer import (
+        StreamingGameScorer,
+    )
+    from photon_ml_tpu.io.model_io import save_game_model
+    from photon_ml_tpu.serving.engine import dataset_rows
+
+    n, d, k = ctx.n, ctx.d, ctx.k
+    model, task, dataset = _make_score_workload(n, d, k)
+    model_dir = os.path.join(ctx.cache_dir, "serve_model")
+    shutil.rmtree(model_dir, ignore_errors=True)
+    save_game_model(model, task, model_dir)
+
+    # Request pool: real dataset rows, every 7th entity id remapped to
+    # an unseen one (the fixed-effect-fallback path stays measured).
+    pool_n = min(SERVE_POOL, n)
+    sub = dataset.take(slice(0, pool_n))
+    ids = np.array(sub.entity_ids["userId"], copy=True)
+    ids[::7] = 10 ** 9 + np.arange(len(ids[::7]))
+    sub.entity_ids = dict(sub.entity_ids)
+    sub.entity_ids["userId"] = ids
+    reqs = dataset_rows(sub, 0, pool_n)
+    bodies = [json.dumps({"rows": reqs[lo: lo + SERVE_ROWS_PER_REQ]})
+              .encode()
+              for lo in range(0, pool_n - SERVE_ROWS_PER_REQ + 1,
+                              SERVE_ROWS_PER_REQ)]
+
+    cfg_path = os.path.join(ctx.cache_dir, "serve_config.json")
+    info_path = os.path.join(ctx.cache_dir, "serve_info.json")
+    for p in (info_path,):
+        if os.path.exists(p):
+            os.remove(p)
+    with open(cfg_path, "w") as f:
+        json.dump({
+            "model_dir": model_dir,
+            "batch_rows": SERVE_BATCH_ROWS,
+            "batch_deadline_ms": 2.0,
+            "ell_row_capacity": max(k, 8),
+            "spill_dir": os.path.join(ctx.cache_dir, "spill_serve"),
+            "hot_swap_poll_s": 0.0,
+            "compilation_cache_dir": (None if ctx.no_compile_cache
+                                      else ctx.cache_dir),
+        }, f)
+    t_start = time.time()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "photon_ml_tpu.serving",
+         "--config", cfg_path, "--info-file", info_path],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    def _startup_fail(msg: str):
+        # Kill BEFORE reading stderr: read() on a live child's pipe
+        # blocks until an EOF that never comes (the startup-timeout
+        # branch reaches here with the server still running).
+        if proc.poll() is None:
+            proc.kill()
+        _out, err = proc.communicate()
+        return RuntimeError(f"serve: {msg}: {(err or '')[-500:]}")
+
+    try:
+        deadline = time.time() + max(60.0, ctx.remaining())
+        while not os.path.exists(info_path):
+            if proc.poll() is not None or time.time() > deadline:
+                raise _startup_fail("server never wrote its info file")
+            time.sleep(0.05)
+        with open(info_path) as f:
+            url = json.load(f)["url"]
+        while True:          # poll /healthz: warming → ready
+            if proc.poll() is not None or time.time() > deadline:
+                raise _startup_fail("server never became ready")
+            try:
+                with urllib.request.urlopen(url + "/healthz",
+                                            timeout=2) as r:
+                    if json.loads(r.read())["state"] == "ready":
+                        break
+            except OSError:
+                pass
+            time.sleep(0.1)
+        warm_wait_s = time.time() - t_start
+
+        def post(body: bytes) -> dict:
+            req = urllib.request.Request(
+                url + "/v1/score", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        latencies: list[list[float]] = [[] for _ in
+                                        range(SERVE_CLIENTS)]
+        errors: list = []
+
+        def client(c: int, measured: bool) -> None:
+            reqs_n = (SERVE_REQS_PER_CLIENT if measured
+                      else SERVE_WARM_REQS)
+            t0 = time.perf_counter()
+            for j in range(reqs_n):
+                # Open loop: fire on the schedule, late or not — queue
+                # delay lands in the measured latency.
+                target = t0 + j * SERVE_INTERVAL_S
+                lag = target - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                body = bodies[(c * 31 + j) % len(bodies)]
+                t1 = time.perf_counter()
+                try:
+                    post(body)
+                except Exception as e:  # noqa: BLE001 - recorded
+                    errors.append(f"{type(e).__name__}: {e}")
+                    continue
+                if measured:
+                    latencies[c].append(time.perf_counter() - t1)
+
+        for measured in (False, True):     # warm storm, then the clock
+            t0 = time.time()
+            threads = [threading.Thread(target=client,
+                                        args=(c, measured))
+                       for c in range(SERVE_CLIENTS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall_s = time.time() - t0
+        lat = np.asarray(sorted(x for c in latencies for x in c))
+        if errors or not len(lat):
+            raise RuntimeError(f"serve: {len(errors)} client "
+                               f"error(s): {errors[:3]}")
+        rows_total = len(lat) * SERVE_ROWS_PER_REQ
+
+        # Parity: one measured request pool scored by the batch path.
+        ref = StreamingGameScorer(
+            model=model, task=task, chunk_rows=pool_n).score(
+            sub, keep_margins=True)
+        out = post(json.dumps({"rows": reqs[:SERVE_ROWS_PER_REQ]})
+                   .encode())
+        parity = float(np.max(np.abs(
+            np.asarray(out["margins"], np.float32)
+            - ref["margins"][:SERVE_ROWS_PER_REQ])))
+
+        with urllib.request.urlopen(url + "/status", timeout=10) as r:
+            status = json.loads(r.read())["serving"]
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            stdout, stderr = proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            stdout, stderr = proc.communicate()
+        sys.stderr.write(stderr[-2000:] if stderr else "")
+    if proc.returncode != 0:
+        raise RuntimeError(f"serve: server exited rc="
+                           f"{proc.returncode}")
+    final = json.loads(
+        [ln for ln in stdout.splitlines() if ln.strip()][-1])
+
+    ctx.record["serve"] = {
+        "clients": SERVE_CLIENTS,
+        "rows_per_request": SERVE_ROWS_PER_REQ,
+        "requests": int(len(lat)),
+        "interval_ms": SERVE_INTERVAL_S * 1e3,
+        "batch_rows": SERVE_BATCH_ROWS,
+        "warm_wait_s": round(warm_wait_s, 2),
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+        "rows_per_sec": round(rows_total / wall_s, 1),
+        "wall_s": round(wall_s, 2),
+        "batch_fill": status["batcher"]["batch_fill"],
+        "batches": status["batcher"]["batches"],
+        "margin_parity_max": parity,
+        "server_peak_rss_mb": status["peak_rss_mb"],
+        "server_rc": final["rc"],
+    }
+    s = ctx.record["serve"]
+    print(f"serve: {SERVE_CLIENTS} clients x "
+          f"{SERVE_REQS_PER_CLIENT} reqs x {SERVE_ROWS_PER_REQ} rows: "
+          f"p50 {s['p50_ms']} ms, p99 {s['p99_ms']} ms, "
+          f"{s['rows_per_sec']} rows/s, batch fill {s['batch_fill']}, "
+          f"parity {parity:.2e}, server peak RSS "
+          f"{s['server_peak_rss_mb']} MB", file=sys.stderr)
+
+
 SECTION_FNS = {
     "etl": section_etl,
     "cached": section_cached,
@@ -1761,6 +1973,7 @@ SECTION_FNS = {
     "score": section_score,
     "re": section_re,
     "cd_fused": section_cd_fused,
+    "serve": section_serve,
 }
 
 

@@ -569,6 +569,118 @@ class ScoringConfig:
                 " only score chunks spill to the disk tier")
 
 
+@dataclasses.dataclass
+class ServingConfig:
+    """Model-server configuration (ISSUE 12): the persistent online
+    scoring process — ``python -m photon_ml_tpu.serving``."""
+
+    # Model source: a checkpoint-manifest directory (model_manifest.npz
+    # — the hot-swap unit) or a legacy metadata.json model dir; both go
+    # through io.model_io.load_game_model (the shared loading path).
+    model_dir: str
+    # HTTP bind (127.0.0.1 only — front a proxy for external traffic);
+    # port 0 asks the kernel for an ephemeral port (the bound port is
+    # in ModelServer.port and the --info-file).
+    host: str = "127.0.0.1"
+    port: int = 0
+    # Micro-batching: concurrent requests coalesce for up to
+    # batch_deadline_ms, then dispatch as ONE fused device program call
+    # padded to the smallest bucket ≥ the batch's row count.  Buckets
+    # are the CLOSED shape set (default: powers of two up to
+    # batch_rows) — every bucket is compiled at warm-up, so the steady
+    # state pays zero compiles (guard-pinned).  Oversized requests
+    # split across buckets.
+    batch_rows: int = 64
+    batch_buckets: list[int] | None = None
+    batch_deadline_ms: float = 2.0
+    max_queue: int = 1024
+    request_timeout_s: float = 30.0
+    # Sparse fixed-effect request rows densify to ELL at this per-row
+    # capacity (part of the closed shape set); a request row with more
+    # non-zeros answers 400 naming this knob.
+    ell_row_capacity: int = 64
+    # Feature shards served as dense vectors (same knob as
+    # ScoringConfig); non-projected random-effect shards are dense
+    # automatically — the model knows which those are.
+    dense_feature_shards: list[str] = dataclasses.field(
+        default_factory=list)
+    # Random-effect coefficient store (serving.entity_store): with a
+    # spill dir (default $PHOTON_ML_TPU_SPILL_DIR) coefficients live in
+    # content-keyed chunked .npz files of entity_chunk entities,
+    # memory-mapped back through an LRU host_max_resident window, with
+    # a persistent entity-id → (chunk, row) index — host RSS is bounded
+    # by the window, not the entity count, and a restart with the same
+    # model reuses the files.  None keeps coefficients host-resident.
+    spill_dir: str | None = None
+    entity_chunk: int = 4096
+    host_max_resident: int = 4
+    # Hot model swap: poll the model dir's manifest at this cadence and
+    # atomically switch to a newly published manifest between batches
+    # (zero dropped requests; a corrupt manifest keeps the previous
+    # good model).  0 disables the watcher.
+    hot_swap_poll_s: float = 2.0
+    # Persistent XLA compilation cache: bucket warm-up compiles are
+    # paid once per program shape across server restarts.
+    compilation_cache_dir: str | None = None
+    # Telemetry/monitoring: the request path is instrumented (latency
+    # histograms, queue-depth gauge, batch-fill counters) through a
+    # telemetry session and the live monitor's alert rules (incl.
+    # serve_tail_latency) — both ON by default: a server without
+    # metrics is blind.  /status + /metrics ride the serving port.
+    telemetry: str = "metrics"
+    monitor: str = "on"
+    monitor_every_s: float = 2.0
+    status_port: int | None = None   # unused: /status rides the port
+    log_path: str | None = None      # run-log JSONL (default: stderr)
+
+    def validate(self) -> None:
+        if not self.model_dir:
+            raise ValueError("serving needs model_dir")
+        if not 0 <= self.port <= 65535:
+            raise ValueError("port must be in [0, 65535] (0 = ephemeral)")
+        if self.batch_rows <= 0:
+            raise ValueError("batch_rows must be positive")
+        if self.batch_buckets is not None:
+            b = list(self.batch_buckets)
+            if not b or any(int(x) <= 0 for x in b):
+                raise ValueError("batch_buckets must be positive")
+            if sorted(set(int(x) for x in b)) != [int(x) for x in b]:
+                raise ValueError(
+                    "batch_buckets must be strictly ascending")
+            if int(b[-1]) != self.batch_rows:
+                raise ValueError(
+                    "batch_buckets must end at batch_rows (the largest "
+                    "bucket IS the max micro-batch)")
+        if self.batch_deadline_ms < 0:
+            raise ValueError("batch_deadline_ms must be >= 0")
+        if self.max_queue <= 0:
+            raise ValueError("max_queue must be positive")
+        if self.request_timeout_s <= 0:
+            raise ValueError("request_timeout_s must be positive")
+        if self.ell_row_capacity <= 0:
+            raise ValueError("ell_row_capacity must be positive")
+        if self.entity_chunk <= 0:
+            raise ValueError("entity_chunk must be positive")
+        if self.host_max_resident < 1:
+            raise ValueError("host_max_resident must be >= 1")
+        if self.hot_swap_poll_s < 0:
+            raise ValueError("hot_swap_poll_s must be >= 0 (0 = off)")
+        if self.telemetry not in ("off", "metrics", "trace"):
+            raise ValueError("telemetry must be off|metrics|trace")
+        _validate_monitor(self)
+
+    def buckets(self) -> list[int]:
+        """The closed micro-batch shape set, smallest first."""
+        if self.batch_buckets is not None:
+            return [int(b) for b in self.batch_buckets]
+        out, b = [], 1
+        while b < self.batch_rows:
+            out.append(b)
+            b *= 2
+        out.append(self.batch_rows)
+        return out
+
+
 # ---------------------------------------------------------------------------
 # JSON (de)serialization.  Enums serialize by value; nested dataclasses by
 # field name — forgiving on input (unknown keys rejected, enums by name or
@@ -654,6 +766,12 @@ def scoring_config_from_json(text: str) -> ScoringConfig:
     return cfg
 
 
+def serving_config_from_json(text: str) -> ServingConfig:
+    cfg = _build(ServingConfig, json.loads(text))
+    cfg.validate()
+    return cfg
+
+
 def load_training_config(path: str) -> TrainingConfig:
     with open(path) as f:
         return training_config_from_json(f.read())
@@ -662,3 +780,8 @@ def load_training_config(path: str) -> TrainingConfig:
 def load_scoring_config(path: str) -> ScoringConfig:
     with open(path) as f:
         return scoring_config_from_json(f.read())
+
+
+def load_serving_config(path: str) -> ServingConfig:
+    with open(path) as f:
+        return serving_config_from_json(f.read())

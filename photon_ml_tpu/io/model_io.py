@@ -13,6 +13,19 @@ arrays, exact round trip of the padded block layout); for interchange
 with reference pipelines, ``export_model_avro`` additionally writes
 per-coordinate ``BayesianLinearModelAvro`` container files keyed by
 (name, term) via the stdlib Avro codec in ``io.avro``.
+
+Checkpoint manifest (ISSUE 12 satellite): ``save_game_model``
+additionally writes ``model_manifest.npz`` — the WHOLE model as ONE
+atomically-replaced file encoded with the reliability checkpoint's
+state-tree codec (``flatten_tree`` + ``atomic_savez``), so
+
+- the model server and the batch drivers share one loading path
+  (``load_game_model`` prefers the manifest when present, falls back
+  to the legacy metadata.json layout otherwise), and
+- the manifest is the HOT-SWAP unit: one ``os.replace`` makes the new
+  model visible, a reader can never observe a torn multi-file write,
+  and a corrupt manifest raises cleanly (the server keeps the previous
+  good model; see ``serving.server``).
 """
 
 from __future__ import annotations
@@ -32,6 +45,151 @@ from photon_ml_tpu.models.game import (
     RandomEffectModel,
 )
 from photon_ml_tpu.models.glm import TaskType
+
+# One-file model manifest: the checkpoint state-tree codec's load unit
+# and the serving tier's hot-swap unit.
+MODEL_MANIFEST_FILE = "model_manifest.npz"
+MODEL_MANIFEST_SCHEMA = 1
+
+
+def model_manifest_path(model_dir: str) -> str:
+    return os.path.join(model_dir, MODEL_MANIFEST_FILE)
+
+
+def _model_tree(model: GameModel, task: TaskType) -> dict:
+    """GameModel → checkpoint state tree (flatten_tree-encodable)."""
+    coords: dict = {}
+    for name, comp in model.models.items():
+        if isinstance(comp, FixedEffectModel):
+            coords[name] = {
+                "kind": "FIXED_EFFECT",
+                "feature_shard": comp.feature_shard,
+                "intercept": bool(comp.intercept),
+                "means": np.asarray(comp.coefficients.means),
+                "variances": (
+                    None if comp.coefficients.variances is None
+                    else np.asarray(comp.coefficients.variances)),
+            }
+        elif isinstance(comp, RandomEffectModel):
+            g = comp.grouping
+            coords[name] = {
+                "kind": "RANDOM_EFFECT",
+                "feature_shard": comp.feature_shard,
+                "entity_key": comp.entity_key,
+                "global_dim": (comp.projection.global_dim
+                               if comp.projection else None),
+                "grouping": {
+                    "entity_ids": np.asarray(g.entity_ids),
+                    "entity_counts": np.asarray(g.entity_counts),
+                    "entity_bucket": np.asarray(g.entity_bucket),
+                    "entity_slot": np.asarray(g.entity_slot),
+                    "capacities": [int(c) for c in g.capacities],
+                    "n_entities": [int(c) for c in g.n_entities],
+                },
+                "blocks": [np.asarray(b)
+                           for b in comp.coefficient_blocks],
+                "variance_blocks": (
+                    None if comp.variance_blocks is None
+                    else [np.asarray(b) for b in comp.variance_blocks]),
+                "proj_feature_ids": (
+                    None if comp.projection is None
+                    else [np.asarray(f)
+                          for f in comp.projection.feature_ids]),
+            }
+        else:
+            raise TypeError(f"unknown component model {type(comp)}")
+    return {"task": task.value, "coordinates": coords}
+
+
+def _model_from_tree(tree: dict) -> tuple[GameModel, TaskType]:
+    task = TaskType(tree["task"])
+    models: dict = {}
+    for name, c in tree["coordinates"].items():
+        if c["kind"] == "FIXED_EFFECT":
+            models[name] = FixedEffectModel(
+                coefficients=Coefficients(
+                    means=jnp.asarray(c["means"]),
+                    variances=(None if c["variances"] is None
+                               else jnp.asarray(c["variances"]))),
+                feature_shard=c["feature_shard"],
+                intercept=bool(c["intercept"]),
+            )
+        elif c["kind"] == "RANDOM_EFFECT":
+            g = c["grouping"]
+            grouping = EntityGrouping(
+                n_examples=0,  # example-level maps are training state
+                entity_ids=g["entity_ids"],
+                entity_counts=g["entity_counts"],
+                entity_bucket=g["entity_bucket"],
+                entity_slot=g["entity_slot"],
+                capacities=[int(x) for x in g["capacities"]],
+                n_entities=[int(x) for x in g["n_entities"]],
+                example_bucket=np.empty(0, np.int64),
+                example_row=np.empty(0, np.int64),
+                example_col=np.empty(0, np.int64),
+            )
+            projection = None
+            if c["proj_feature_ids"] is not None:
+                projection = SubspaceProjection(
+                    feature_ids=list(c["proj_feature_ids"]),
+                    global_dim=int(c["global_dim"]),
+                )
+            models[name] = RandomEffectModel(
+                coefficient_blocks=[jnp.asarray(b)
+                                    for b in c["blocks"]],
+                grouping=grouping,
+                feature_shard=c["feature_shard"],
+                variance_blocks=(
+                    None if c["variance_blocks"] is None
+                    else [jnp.asarray(b)
+                          for b in c["variance_blocks"]]),
+                projection=projection,
+                entity_key=c["entity_key"],
+            )
+        else:
+            raise ValueError(f"unknown coordinate kind {c['kind']!r}")
+    return GameModel(models=models), task
+
+
+def save_model_manifest(model: GameModel, task: TaskType,
+                        out_dir: str) -> str:
+    """Write the one-file checkpoint manifest (atomic tmp +
+    ``os.replace`` — the hot-swap publish primitive).  Returns its
+    path."""
+    from photon_ml_tpu.cache.plan_cache import atomic_savez
+    from photon_ml_tpu.reliability.checkpoint import flatten_tree
+
+    os.makedirs(out_dir, exist_ok=True)
+    tree_meta, arrays = flatten_tree(_model_tree(model, task))
+    path = model_manifest_path(out_dir)
+    atomic_savez(path, {"kind": "game_model",
+                        "schema": MODEL_MANIFEST_SCHEMA,
+                        "tree": tree_meta}, arrays)
+    return path
+
+
+def load_model_manifest(model_dir: str) -> tuple[GameModel, TaskType]:
+    """Load a model from ``<model_dir>/model_manifest.npz``.  Raises on
+    a missing/corrupt/mismatched file — the server's swap watcher
+    catches and keeps the previous good model."""
+    from photon_ml_tpu.reliability.checkpoint import unflatten_tree
+
+    path = model_manifest_path(model_dir)
+    with np.load(path, allow_pickle=False) as z:
+        if "__meta__" not in z.files:
+            raise ValueError(f"model manifest {path}: no __meta__ "
+                             "member (not an atomic_savez file)")
+        meta = json.loads(bytes(np.asarray(z["__meta__"])).decode())
+        arrays = {key: np.asarray(z[key]) for key in z.files
+                  if key != "__meta__"}
+    if meta.get("kind") != "game_model":
+        raise ValueError(f"model manifest {path}: kind "
+                         f"{meta.get('kind')!r} != 'game_model'")
+    if meta.get("schema") != MODEL_MANIFEST_SCHEMA:
+        raise ValueError(f"model manifest {path}: schema "
+                         f"{meta.get('schema')!r} != "
+                         f"{MODEL_MANIFEST_SCHEMA}")
+    return _model_from_tree(unflatten_tree(meta["tree"], arrays))
 
 
 def save_game_model(model: GameModel, task: TaskType, out_dir: str) -> None:
@@ -79,9 +237,18 @@ def save_game_model(model: GameModel, task: TaskType, out_dir: str) -> None:
             raise TypeError(f"unknown component model {type(comp)}")
     with open(os.path.join(out_dir, "metadata.json"), "w") as f:
         json.dump(meta, f, indent=2)
+    # The manifest goes LAST: its atomic replace is the publish signal
+    # a serving hot-swap watcher polls, and every file it could point a
+    # legacy-path reader at already exists by now.
+    save_model_manifest(model, task, out_dir)
 
 
 def load_game_model(model_dir: str) -> tuple[GameModel, TaskType]:
+    """Load a model directory: the one-file checkpoint manifest when
+    present (the serving/batch shared path), else the legacy
+    metadata.json + per-coordinate npz layout."""
+    if os.path.exists(model_manifest_path(model_dir)):
+        return load_model_manifest(model_dir)
     with open(os.path.join(model_dir, "metadata.json")) as f:
         meta = json.load(f)
     task = TaskType(meta["task_type"])

@@ -1,0 +1,33 @@
+"""Online serving tier (ISSUE 12): the first request path.
+
+Everything before this package is batch — Avro in, Avro/npz out, one
+process per pass.  The serving tier is a persistent model-server
+process that keeps the fused scoring program warm and answers
+``POST /v1/score`` requests at micro-batch latency:
+
+- ``serving.http``: the shared threaded HTTP core (stdlib
+  ``ThreadingHTTPServer`` + a route table + warming/ready readiness
+  semantics) — also the base of the monitor's status endpoint.
+- ``serving.entity_store``: random-effect coefficients served from an
+  mmap'd chunked disk store with a persistent entity-id index.
+- ``serving.engine``: the model-only scoring plan — the streaming
+  scorer's fused per-chunk device program (``_run_chunk``) dispatched
+  on padded request batches from a CLOSED bucket shape set.
+- ``serving.batcher``: the deadline-based micro-batcher coalescing
+  concurrent requests into those buckets.
+- ``serving.server``: ``ModelServer`` — checkpoint-manifest load,
+  bucket warm-up, hot model swap, the HTTP surface; run it with
+  ``python -m photon_ml_tpu.serving --config serve.json``.
+"""
+
+# NOTE: no eager submodule imports — ``telemetry.monitor`` imports the
+# shared HTTP core from ``serving.http``, and an eager ``server`` import
+# here would close an import cycle through the telemetry package.
+
+
+def __getattr__(name: str):
+    if name == "ModelServer":
+        from photon_ml_tpu.serving.server import ModelServer
+
+        return ModelServer
+    raise AttributeError(name)

@@ -1,0 +1,264 @@
+"""Deadline-based micro-batcher: concurrent requests → fused batches.
+
+The serving tier's throughput lever (ISSUE 12): a single request row
+would waste the fused program's parallelism, so concurrent requests
+COALESCE — the dispatcher collects queued requests until either the
+largest bucket fills or the oldest request has waited
+``deadline_s``, then dispatches them as ONE padded device call.  Under
+light load a request pays at most the deadline of extra latency; under
+heavy load batches fill and the deadline never binds — throughput
+scales with batch fill, the Snap ML pipelining argument one level up.
+
+Shape discipline: batches pad to the CLOSED ``buckets`` set (compiled
+at warm-up), so the steady state never compiles.  A request larger
+than the biggest bucket splits across several dispatches and
+reassembles transparently.
+
+Hot swap: the batcher holds NO model state — every dispatch fetches
+the current engine through ``engine_fn`` at batch-formation time, so a
+swap lands between batches by construction: in-flight batches finish
+on the old engine, the next batch opens on the new one, and no request
+is ever dropped or torn across models.
+
+Thread contract (photon-lint ``unlocked-shared-write``): request slots
+hand results across threads under their own condition variable; the
+dispatcher is the only thread forming batches; counters shared with
+the stats endpoint mutate under one lock.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+import numpy as np
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import monitor as _mon
+
+logger = logging.getLogger(__name__)
+
+
+class ServerClosing(RuntimeError):
+    """Submitted while the server is draining (HTTP 503)."""
+
+
+class ServerSaturated(RuntimeError):
+    """The request queue is full (HTTP 429): shed load instead of
+    queueing into timeout."""
+
+
+class _Slot:
+    """One request's result hand-off (condition-guarded)."""
+
+    __slots__ = ("rows", "n", "_cv", "_done", "result", "error",
+                 "version")
+
+    def __init__(self, rows, n: int):
+        self.rows = rows
+        self.n = n
+        self._cv = threading.Condition()
+        self._done = False
+        self.result = None       # (margins, preds) slices
+        self.error: BaseException | None = None
+        self.version: str | None = None
+
+    def finish(self, result=None, error=None, version=None) -> None:
+        with self._cv:
+            self.result = result
+            self.error = error
+            self.version = version
+            self._done = True
+            self._cv.notify_all()
+
+    def wait(self, timeout: float):
+        with self._cv:
+            if not self._cv.wait_for(lambda: self._done, timeout):
+                raise TimeoutError(
+                    f"scoring request timed out after {timeout:g}s "
+                    "(server overloaded or wedged)")
+        if self.error is not None:
+            raise self.error
+        return self.result, self.version
+
+
+class MicroBatcher:
+    """The dispatcher thread + bounded request queue.
+
+    ``engine_fn() -> ScoringEngine`` resolves the CURRENT engine per
+    batch (the hot-swap seam).  ``buckets`` is the closed, ascending
+    shape set; ``deadline_s`` the max coalescing wait for the oldest
+    queued request.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, engine_fn, buckets: list[int],
+                 deadline_s: float = 0.002, max_queue: int = 1024,
+                 clock=time.monotonic):
+        if not buckets or sorted(buckets) != list(buckets):
+            raise ValueError("buckets must be non-empty ascending")
+        self._engine_fn = engine_fn
+        self.buckets = [int(b) for b in buckets]
+        self.max_rows = self.buckets[-1]
+        self.deadline_s = float(deadline_s)
+        self._clock = clock
+        # Unbounded Queue; max_queue is enforced in submit() under the
+        # batcher lock — puts then never block, so both submit() and
+        # close() can enqueue while HOLDING the lock (the ordering
+        # guarantee vs the drain sentinel).
+        self._q: queue.Queue = queue.Queue()
+        self.max_queue = int(max_queue)
+        self._lock = threading.Lock()
+        self._closing = False
+        self.batches = 0
+        self.rows = 0
+        self.padded_rows = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="photon-serve-batcher")
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, parsed_rows: list, timeout_s: float = 30.0):
+        """Block until scored: → (margins [n], preds [n], version).
+        Called from HTTP handler threads; oversized requests split
+        across ≤max_rows slots and reassemble here."""
+        t0 = time.perf_counter()
+        slots = []
+        # Enqueue UNDER the closing lock (put_nowait never blocks, so
+        # holding it is safe): close() sets _closing and appends the
+        # drain sentinel under the same lock, so no slot can ever land
+        # BEHIND the sentinel and hang its client until timeout.
+        with self._lock:
+            if self._closing:
+                raise ServerClosing("server is draining")
+            for lo in range(0, len(parsed_rows), self.max_rows):
+                piece = parsed_rows[lo: lo + self.max_rows]
+                if self._q.qsize() >= self.max_queue:
+                    # Shed load; requests already queued from this
+                    # submit still score (their slots just get
+                    # abandoned results).
+                    raise ServerSaturated(
+                        f"request queue full ({self.max_queue}); "
+                        "shed load or raise max_queue")
+                slot = _Slot(piece, len(piece))
+                self._q.put(slot)
+                slots.append(slot)
+        telemetry.gauge("serve.queue_depth", self._q.qsize())
+        margins, preds, version = [], [], None
+        for slot in slots:
+            (m, p), version = slot.wait(timeout_s)
+            margins.append(m)
+            preds.append(p)
+        dt = time.perf_counter() - t0
+        telemetry.count("serve.requests")
+        telemetry.observe("serve.request_s", dt)
+        return (np.concatenate(margins), np.concatenate(preds), version)
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_rows
+
+    def _run(self) -> None:
+        carry = None
+        while True:
+            item = carry if carry is not None else self._q.get()
+            carry = None
+            if item is self._SENTINEL:
+                return
+            batch = [item]
+            total = item.n
+            deadline = self._clock() + self.deadline_s
+            while total < self.max_rows:
+                wait = deadline - self._clock()
+                if wait <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=wait)
+                except queue.Empty:  # photon-lint: disable=swallowed-exception (the deadline expiring IS the dispatch signal, not a failure)
+                    break
+                if nxt is self._SENTINEL:
+                    carry = nxt        # dispatch, then exit next loop
+                    break
+                if total + nxt.n > self.max_rows:
+                    carry = nxt        # opens the next batch
+                    break
+                batch.append(nxt)
+                total += nxt.n
+            self._dispatch(batch, total)
+
+    def _dispatch(self, batch: list, total: int) -> None:
+        t0 = time.perf_counter()
+        bucket = self._bucket_for(total)
+        try:
+            # The hot-swap seam: the engine is resolved HERE, once per
+            # batch — a swap between batches is atomic for every
+            # request in flight.
+            engine = self._engine_fn()
+            rows = [r for slot in batch for r in slot.rows]
+            margins, preds = engine.score_batch(rows, bucket)
+            lo = 0
+            for slot in batch:
+                hi = lo + slot.n
+                slot.finish(result=(margins[lo:hi], preds[lo:hi]),
+                            version=engine.version)
+                lo = hi
+        except BaseException as e:
+            telemetry.thread_exception("serve-batcher", e)
+            for slot in batch:
+                slot.finish(error=e)
+            return
+        finally:
+            with self._lock:
+                self.batches += 1
+                self.rows += total
+                self.padded_rows += bucket
+        telemetry.count("serve.batches")
+        telemetry.count("serve.batch_rows", total)
+        telemetry.observe("serve.batch_fill", total / bucket)
+        telemetry.observe("serve.batch_s", time.perf_counter() - t0)
+        telemetry.gauge("serve.queue_depth", self._q.qsize())
+        # Live progress + the alert seam: rule evaluation (incl.
+        # serve_tail_latency) runs at the monitor's snapshot cadence
+        # FROM progress() — without this call the serving process
+        # would record latencies nothing ever judges.  One global read
+        # when monitoring is off.
+        _mon.progress("serve", self.rows, unit="rows",
+                      batches=self.batches)
+
+    # -- stats / lifecycle ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            batches, rows, padded = (self.batches, self.rows,
+                                     self.padded_rows)
+        return {
+            "batches": batches, "rows": rows,
+            "queue_depth": self._q.qsize(),
+            "batch_fill": (round(rows / padded, 4) if padded else None),
+            "buckets": list(self.buckets),
+            "deadline_ms": round(self.deadline_s * 1e3, 3),
+        }
+
+    def close(self) -> None:
+        """Drain: refuse new submits, score everything queued, stop."""
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+            # Sentinel goes in under the SAME lock submits enqueue
+            # under: every accepted slot is in front of it, so the
+            # drain contract ("score everything queued") holds.  The
+            # queue is unbounded, so this put never blocks while the
+            # lock is held.
+            self._q.put(self._SENTINEL)
+        self._thread.join(timeout=30.0)
+        if self._thread.is_alive():   # pragma: no cover - wedged device
+            logger.warning("serve batcher did not drain within 30s")

@@ -1,0 +1,210 @@
+"""mmap'd random-effect coefficient store for the serving tier.
+
+A GAME model's random effects are per-ENTITY coefficient rows — at the
+"millions of users" scale the serving tier exists for, the [E, p]
+table is the one model component that must not live in anonymous host
+RSS (everything else is O(features)).  This module serves it from the
+round-8 disk tier instead (ISSUE 12 tentpole):
+
+- **Chunked coefficient files**: the model's rows, in global entity
+  order (``grouping.entity_ids`` — ``np.unique`` ascending), split
+  into ``entity_chunk``-row chunks and spilled through
+  ``data.chunk_store.ChunkStore`` with the flat array codec — atomic
+  content-keyed ``.npz`` files, memory-mapped loads, an LRU
+  ``host_max_resident`` window.  A restart with the same model finds
+  the same content key and reuses every file (warm artifact, the
+  plan-cache discipline).
+- **Persistent entity-id → (chunk, row) index**: one sidecar ``.npz``
+  holding the sorted id array, memory-mapped back for lookups — the
+  id → global-position join is a ``searchsorted`` against FILE-BACKED
+  pages, and position ``g`` maps to ``(g // entity_chunk,
+  g % entity_chunk)`` by construction (chunking is contiguous in
+  global entity order).
+- **Unseen entities**: join misses return ``hit=False`` and ZERO rows
+  — the caller's mini-table keeps the zero fallback row, so an unseen
+  entity scores exactly the fixed effect (the batch path's tested
+  semantics).
+
+Without a (writable) spill dir the store degrades to a host-resident
+table with one warning — the disk tier is an optimization for the big-E
+regime, never a correctness dependency (the ``probe_spill_dir`` rule).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.data.chunk_store import (
+    ChunkStore,
+    _open_npz_mmap,
+    array_content_key,
+    decode_array_chunk,
+    encode_array_chunk,
+    probe_spill_dir,
+)
+from photon_ml_tpu.game.dataset import sorted_id_join
+from photon_ml_tpu.models.game import RandomEffectModel
+
+logger = logging.getLogger(__name__)
+
+# On-disk serve-store format version (rides in the content key).
+ENTITY_STORE_VERSION = 1
+
+
+def _extract_rows(model: RandomEffectModel, lo: int, hi: int,
+                  blocks_np: list[np.ndarray]) -> np.ndarray:
+    """Coefficient rows [hi-lo, p] for global entity positions
+    [lo, hi) — vectorized gather from the size-bucketed blocks."""
+    g = model.grouping
+    bucket = np.asarray(g.entity_bucket[lo:hi])
+    slot = np.asarray(g.entity_slot[lo:hi])
+    out = np.zeros((hi - lo, blocks_np[0].shape[-1]), np.float32)
+    for b in np.unique(bucket):
+        sel = bucket == b
+        out[sel] = blocks_np[b][slot[sel]]
+    return out
+
+
+class EntityServeStore:
+    """Per-entity coefficient rows behind an id join.
+
+    Construct via ``build`` (from a ``RandomEffectModel``).  ``lookup``
+    is the serving hot path: query ids → coefficient rows + hit mask,
+    touching only the chunks the batch's entities live in.
+    """
+
+    def __init__(self, name: str, ids: np.ndarray, dim: int,
+                 entity_chunk: int, store: ChunkStore | None,
+                 table: np.ndarray | None):
+        self.name = name
+        self._ids = ids                  # sorted unique (possibly mmap)
+        self.dim = int(dim)
+        self.entity_chunk = int(entity_chunk)
+        self._store = store              # chunked disk tier, or
+        self._table = table              # ...resident fallback
+        self.n_entities = int(len(ids))
+        self.lookups = 0
+        self.misses = 0                  # unseen-entity rows served
+
+    @property
+    def spilled(self) -> bool:
+        return self._store is not None
+
+    @classmethod
+    def build(cls, name: str, model: RandomEffectModel,
+              spill_dir: str | None, entity_chunk: int = 4096,
+              host_max_resident: int = 4) -> "EntityServeStore":
+        if model.projection is not None:
+            raise ValueError(
+                f"random effect '{name}' is projected; the entity "
+                "serve store holds width-uniform rows (projected "
+                "effects score host-side)")
+        g = model.grouping
+        ids = np.asarray(g.entity_ids)
+        blocks_np = [np.asarray(b, np.float32)
+                     for b in model.coefficient_blocks]
+        dim = blocks_np[0].shape[-1]
+        E = len(ids)
+        C = int(entity_chunk)
+        n_chunks = max(1, -(-E // C))
+
+        if probe_spill_dir(spill_dir) is None:
+            # Resident fallback: one [E, p] table (the pre-serving
+            # shape) — correct, just not RSS-bounded in E.
+            table = _extract_rows(model, 0, E, blocks_np)
+            logger.info("entity serve store '%s': resident (%d entities"
+                        " x %d, no spill dir)", name, E, dim)
+            return cls(name, ids, dim, C, None, table)
+
+        key = "resrv-" + array_content_key(
+            [ids] + blocks_np,
+            {"entity_chunk": C, "dim": int(dim),
+             "version": ENTITY_STORE_VERSION})
+
+        def build_chunk(i: int) -> dict:
+            lo = i * C
+            hi = min(lo + C, E)
+            return {"w": _extract_rows(model, lo, hi, blocks_np)}
+
+        store = ChunkStore(spill_dir, key, n_chunks,
+                           host_max_resident=host_max_resident,
+                           rebuild=build_chunk,
+                           codec=(encode_array_chunk,
+                                  decode_array_chunk))
+        missing = [i for i in range(n_chunks) if not store.has(i)]
+        for i in missing:        # one chunk in flight: bounded ETL RSS
+            store.put(i, build_chunk(i), keep_resident=False)
+
+        # Persistent id index: written once per content key, mmap'd
+        # back so the E-sized join array is file-backed page cache, not
+        # anonymous RSS.
+        index_path = os.path.join(store.dir, f"{key}-index.npz")
+        ids_view = ids
+        try:
+            if not os.path.exists(index_path):
+                from photon_ml_tpu.cache.plan_cache import atomic_savez
+
+                atomic_savez(index_path,
+                             {"kind": "entity_serve_index",
+                              "version": ENTITY_STORE_VERSION,
+                              "entity_chunk": C, "dim": int(dim)},
+                             {"ids": ids})
+            ids_view = _open_npz_mmap(index_path)["ids"]
+        except Exception as e:  # photon-lint: disable=swallowed-exception (index persistence is an optimization; the in-memory ids are authoritative)
+            logger.warning("entity serve store '%s': id index at %s "
+                           "unavailable (%r); using resident ids",
+                           name, index_path, e)
+        logger.info(
+            "entity serve store '%s': %d entities x %d in %d chunk(s) "
+            "at %s (%d built, %d reused; host window %d)", name, E,
+            dim, n_chunks, spill_dir, len(missing),
+            n_chunks - len(missing), store.host_max_resident)
+        return cls(name, ids_view, dim, C, store, None)
+
+    def lookup(self, query_ids: np.ndarray
+               ) -> tuple[np.ndarray, np.ndarray]:
+        """(rows [m, p] float32, hit [m] bool) for ``query_ids``.
+        Misses (unseen entities) come back as zero rows."""
+        query_ids = np.asarray(query_ids)
+        m = len(query_ids)
+        g = sorted_id_join(np.asarray(self._ids), query_ids)
+        hit = g >= 0
+        out = np.zeros((m, self.dim), np.float32)
+        self.lookups += m
+        n_miss = int(m - hit.sum())
+        if n_miss:
+            self.misses += n_miss
+            telemetry.count("serve.entity_misses", n_miss)
+        if self._table is not None:
+            out[hit] = self._table[g[hit]]
+            return out, hit
+        gh = g[hit]
+        rows_out = np.nonzero(hit)[0]
+        for c in np.unique(gh // self.entity_chunk):
+            sel = (gh // self.entity_chunk) == c
+            w = self._store.get(int(c))["w"]
+            # Fancy-indexing a memmap copies just the touched rows —
+            # the batch's working set, not the chunk.
+            out[rows_out[sel]] = w[gh[sel] - int(c) * self.entity_chunk]
+        return out, hit
+
+    def stats(self) -> dict:
+        st = {"name": self.name, "entities": self.n_entities,
+              "dim": self.dim, "spilled": self.spilled,
+              "lookups": self.lookups, "misses": self.misses}
+        if self._store is not None:
+            st.update({"chunk_loads": self._store.loads,
+                       "window_hits": self._store.hits,
+                       "peak_resident": self._store.peak_resident})
+        return st
+
+    def close(self) -> None:
+        """Drop the decoded-chunk window (retiring a swapped-out
+        model's store).  Files stay on disk — they are content-keyed
+        warm artifacts, exactly like every other chunk store."""
+        if self._store is not None:
+            self._store.drop_resident()

@@ -1,0 +1,208 @@
+"""Shared threaded HTTP core: route table + readiness semantics.
+
+One stdlib ``ThreadingHTTPServer`` wrapper serves BOTH HTTP surfaces in
+the package — the monitor's observer endpoint (``/status`` +
+``/metrics``, ISSUE 10) and the model server's request path
+(``/v1/score``, ISSUE 12).  Promoting the monitor's private
+``_StatusServer`` into this module is the tentpole's first move: the
+request path must not fork a second, slightly different server loop.
+
+Readiness (ISSUE 12 satellite): every endpoint built on this core
+answers ``GET /healthz`` with the SAME state machine —
+
+- ``warming`` → **503**: the process is up but not serviceable yet
+  (model loading, plan build, XLA compile in progress).  A load
+  balancer or orchestrator probe must NOT route traffic here.
+- ``ready`` → **200**: warm — the first request pays zero compiles.
+- ``stopping`` → **503**: graceful drain in progress.
+
+The previous monitor endpoint answered an unconditional 200 the moment
+the socket bound, i.e. during exactly the plan/compile window where a
+probe answer matters; both surfaces now report honestly.
+
+Import discipline: stdlib only — ``telemetry.monitor`` imports this
+module, so anything heavier would cycle through the package.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+WARMING = "warming"
+READY = "ready"
+STOPPING = "stopping"
+
+_STATES = (WARMING, READY, STOPPING)
+
+
+class Readiness:
+    """Thread-safe readiness state + human reason.
+
+    Writers (the owning server's lifecycle) call ``set(state, reason)``;
+    the HTTP thread reads ``snapshot()``.  ``healthz_body()`` is the
+    shared wire format: ``{"ok": bool, "state": str, "reason": str?}``.
+    """
+
+    def __init__(self, state: str = WARMING, reason: str | None = None):
+        self._lock = threading.Lock()
+        self._state = state
+        self._reason = reason
+        self._check(state)
+
+    @staticmethod
+    def _check(state: str) -> None:
+        if state not in _STATES:
+            raise ValueError(
+                f"readiness state {state!r} not in {_STATES}")
+
+    def set(self, state: str, reason: str | None = None) -> None:
+        self._check(state)
+        with self._lock:
+            self._state = state
+            self._reason = reason
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def snapshot(self) -> tuple[str, str | None]:
+        with self._lock:
+            return self._state, self._reason
+
+    def healthz(self) -> tuple[int, dict]:
+        """(HTTP code, JSON body) for ``GET /healthz``."""
+        state, reason = self.snapshot()
+        body = {"ok": state == READY, "state": state}
+        if reason:
+            body["reason"] = reason
+        return (200 if state == READY else 503), body
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    """Route-table dispatch; the endpoint rides as a class attribute
+    (one handler class per ``HttpEndpoint`` instance)."""
+
+    endpoint: "HttpEndpoint | None" = None
+
+    # Request paths are small JSON (scoring rows); cap the body read so
+    # a hostile Content-Length cannot balloon the handler thread.
+    MAX_BODY = 32 << 20
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj), "application/json")
+
+    def _dispatch(self, method: str) -> None:
+        ep = self.endpoint
+        path = self.path.split("?", 1)[0]
+        if path in ("/", "/healthz"):
+            # "/" doubles as the health probe (the round-15 monitor
+            # endpoint answered it; existing probes keep working) —
+            # with the honest state machine, not an unconditional 200.
+            code, body = ep.readiness.healthz()
+            self._send_json(code, body)
+            return
+        route = ep.routes.get((method, path))
+        if route is None:
+            self._send_json(404, {
+                "error": "unknown route",
+                "routes": sorted({p for _, p in ep.routes} | {"/healthz"}),
+            })
+            return
+        body = b""
+        if method == "POST":
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                length = 0
+            if length > self.MAX_BODY:
+                self._send_json(413, {"error": "request body too large",
+                                      "max_bytes": self.MAX_BODY})
+                return
+            body = self.rfile.read(length) if length else b""
+        try:
+            code, payload, ctype = route(body)
+        except HttpError as e:
+            code, payload, ctype = e.code, json.dumps(e.body), \
+                "application/json"
+        except Exception as e:   # a handler bug must answer, not hang
+            logger.exception("http route %s %s failed", method, path)
+            code, payload, ctype = 500, json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}), "application/json"
+        self._send(code, payload, ctype)
+
+    def do_GET(self) -> None:    # noqa: N802 (http.server API)
+        self._dispatch("GET")
+
+    def do_POST(self) -> None:   # noqa: N802 (http.server API)
+        self._dispatch("POST")
+
+    def log_message(self, format, *args):   # noqa: A002 (stdlib API)
+        logger.debug("http: " + format, *args)
+
+
+class HttpError(Exception):
+    """Raise from a route handler to answer a structured error."""
+
+    def __init__(self, code: int, **body):
+        self.code = int(code)
+        self.body = body
+        super().__init__(f"{code}: {body}")
+
+
+class HttpEndpoint:
+    """The threaded server: binds at construction (port 0 = ephemeral;
+    the bound port is in ``.port``), serves after ``start()``.
+
+    ``routes``: ``{(method, path): fn(body: bytes) -> (code, payload,
+    content_type)}``.  ``/healthz`` is built in, answered from
+    ``readiness`` (see module docstring) — routes cannot shadow it.
+    Handlers run on per-connection daemon threads (stdlib
+    ``ThreadingHTTPServer``); blocking inside a handler (the scoring
+    path waits on its micro-batch) stalls only that connection.
+
+    Binds 127.0.0.1 by default: both surfaces are operator tools, not
+    public internet listeners; fronting proxies own external exposure.
+    """
+
+    def __init__(self, routes: dict, readiness: Readiness | None = None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        self.routes = dict(routes)
+        self.readiness = readiness if readiness is not None \
+            else Readiness(READY)
+        handler = type("_BoundHandler", (_Handler,), {"endpoint": self})
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._started = False
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="photon-http-endpoint")
+
+    def start(self) -> None:
+        self._thread.start()
+        self._started = True
+
+    def close(self) -> None:
+        # shutdown() waits on an event only serve_forever() sets: a
+        # never-started server (error-path close) must skip it or the
+        # close deadlocks forever.
+        if self._started:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5.0)
+        self._httpd.server_close()

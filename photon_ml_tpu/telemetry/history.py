@@ -66,6 +66,12 @@ METRICS: tuple[tuple[str, str, str], ...] = (
     ("cd_fused", "cd_fused.passes_per_cycle_fused", "lower"),
     ("cd_fused", "cd_fused.pass_time_ratio", "lower"),
     ("cd_fused", "cd_fused.fused.rows_per_sec", "higher"),
+    # Online serving (ISSUE 12): tail latency creeping up, sustained
+    # throughput dropping, or micro-batch fill collapsing (the
+    # batcher degenerating to single-row dispatches) all gate.
+    ("serve", "serve.p99_ms", "lower"),
+    ("serve", "serve.rows_per_sec", "higher"),
+    ("serve", "serve.batch_fill", "higher"),
 )
 
 

@@ -45,7 +45,6 @@ locked) ``RunLogger``.
 from __future__ import annotations
 
 import contextlib
-import http.server
 import json
 import logging
 import math
@@ -55,6 +54,16 @@ import threading
 import time
 
 from photon_ml_tpu import telemetry
+# The status endpoint rides the SAME threaded HTTP core as the model
+# server's request path (ISSUE 12): one server loop, one readiness
+# state machine.  serving.http is stdlib-only, so no import cycle.
+from photon_ml_tpu.serving.http import (
+    READY,
+    STOPPING,
+    WARMING,
+    HttpEndpoint,
+    Readiness,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -93,6 +102,13 @@ DEFAULT_THRESHOLDS: dict = {
     # ratio and this many MB since the monitor's first sample.
     "memory_growth_ratio": 1.5,
     "memory_growth_min_mb": 256.0,
+    # serve_tail_latency (ISSUE 12): the serving tier's per-request
+    # latency p99 (the bounded-reservoir rolling estimate over
+    # serve.request_s) above this many seconds, once at least
+    # serve_min_requests requests are on record — the online signal
+    # that the micro-batcher/device path is falling behind its SLO.
+    "serve_p99_s": 0.5,
+    "serve_min_requests": 20,
 }
 
 _ACTIVE: "Monitor | None" = None
@@ -181,6 +197,15 @@ class Monitor:
         self._sink_high_streak = 0
         self._dev_first_bytes: float | None = None
         self._closed = False
+        # Readiness for /healthz (ISSUE 12 satellite): the monitored
+        # run is WARMING — plan build / XLA compile / first work unit
+        # in progress — until the first progress snapshot arrives, then
+        # READY.  The old endpoint answered an unconditional 200 from
+        # the moment the socket bound; a probe now gets the same
+        # warming→503 / ready→200 semantics as the model server.
+        self.readiness = Readiness(
+            WARMING, reason="no progress snapshot yet "
+                            "(plan/compile or first work unit pending)")
         self._server: _StatusServer | None = None
         self.status_port: int | None = None
         if status_port is not None:
@@ -206,6 +231,7 @@ class Monitor:
         if self._closed:
             return
         self._closed = True
+        self.readiness.set(STOPPING, reason="monitor closing")
         if self._server is not None:
             self._server.close()
             self._server = None
@@ -234,10 +260,18 @@ class Monitor:
 
     # -- progress ------------------------------------------------------------
 
+    def mark_ready(self) -> None:
+        """Flip /healthz to ready (200).  Progress snapshots do this
+        implicitly — work flowing means the warm-up is behind us; the
+        model server calls it explicitly after its bucket warm-up."""
+        self.readiness.set(READY)
+
     def progress(self, stage: str, done, total=None,
                  unit: str = "units", **fields) -> None:
         now = self._clock()
         done = float(done)
+        if self.readiness.state == WARMING:
+            self.mark_ready()
         with self._lock:
             st = self._stages.get(stage)
             first = st is None
@@ -410,6 +444,24 @@ class Monitor:
                        f"(threshold {th['retry_rate_per_s']:g}/s); the "
                        "spill-dir storage is degrading",
                        retries_per_s=round(retry_rate, 3))
+        # serve_tail_latency (ISSUE 12): the serving tier's request
+        # latency histogram, once enough requests are on record.  The
+        # p99 comes from the bounded reservoir — a stride-decimated
+        # rolling estimate of the stream, the same estimator /metrics
+        # exposes — and the rule latches per (rule, stage) like every
+        # other rule: one alert per incident, not one per snapshot.
+        p99 = t.percentile("serve.request_s", 0.99)
+        if (p99 is not None
+                and t.counter("serve.requests") >= th["serve_min_requests"]
+                and p99 > th["serve_p99_s"]):
+            self._fire(
+                "serve_tail_latency", "serve",
+                f"p99 request latency {p99 * 1e3:.1f} ms exceeds the "
+                f"{th['serve_p99_s'] * 1e3:.0f} ms threshold; the "
+                "serving tier is missing its tail SLO",
+                p99_ms=round(p99 * 1e3, 2),
+                threshold_ms=round(th["serve_p99_s"] * 1e3, 2),
+                requests=t.counter("serve.requests"))
         depth = t.gauge_value("sink.queue_depth")
         with self._lock:
             if (depth is not None
@@ -547,70 +599,38 @@ def prometheus_text(monitor: "Monitor | None" = None,
     return "\n".join(lines) + "\n"
 
 
-class _Handler(http.server.BaseHTTPRequestHandler):
-    """GET-only status handler; the monitor rides as a class attribute
-    (one handler class per server instance, see ``_StatusServer``)."""
-
-    monitor: "Monitor | None" = None
-
-    def _send(self, code: int, body: str, ctype: str) -> None:
-        data = body.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
-
-    def do_GET(self) -> None:   # noqa: N802 (http.server API)
-        path = self.path.split("?", 1)[0]
-        if path == "/status":
-            self._send(200, json.dumps(self.monitor.status()),
-                       "application/json")
-        elif path == "/metrics":
-            self._send(200, prometheus_text(self.monitor),
-                       "text/plain; version=0.0.4")
-        elif path in ("/", "/healthz"):
-            self._send(200, json.dumps({"ok": True}), "application/json")
-        else:
-            self._send(404, json.dumps(
-                {"error": "unknown route",
-                 "routes": ["/status", "/metrics", "/healthz"]}),
-                "application/json")
-
-    def log_message(self, format, *args):   # noqa: A002 (stdlib API)
-        logger.debug("status-server: " + format, *args)
+def status_routes(monitor: "Monitor") -> dict:
+    """The monitor's observer routes for the shared HTTP core —
+    ``/status`` (live JSON snapshot) + ``/metrics`` (Prometheus text).
+    The model server mounts the same routes next to its ``/v1/score``
+    request path, so the two surfaces cannot drift."""
+    return {
+        ("GET", "/status"): lambda body: (
+            200, json.dumps(monitor.status()), "application/json"),
+        ("GET", "/metrics"): lambda body: (
+            200, prometheus_text(monitor), "text/plain; version=0.0.4"),
+    }
 
 
 class _StatusServer:
-    """The opt-in HTTP thread.  Binds 127.0.0.1 only (a run monitor is
-    an operator tool, not a public surface); port 0 asks the kernel for
-    an ephemeral port — the bound one is in ``.port``."""
+    """The opt-in observer endpoint: the shared ``HttpEndpoint`` core
+    with the monitor's routes and readiness (``/healthz`` answers 503
+    while the run is still warming, 200 once progress flows).  Binds
+    127.0.0.1 only; port 0 asks the kernel for an ephemeral port — the
+    bound one is in ``.port``."""
 
     def __init__(self, monitor: Monitor, port: int,
                  host: str = "127.0.0.1"):
-        handler = type("_BoundHandler", (_Handler,),
-                       {"monitor": monitor})
-        self._httpd = http.server.ThreadingHTTPServer((host, port),
-                                                      handler)
-        self._httpd.daemon_threads = True
-        self.port = int(self._httpd.server_address[1])
-        self._started = False
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
-            name="photon-status-server")
+        self._ep = HttpEndpoint(status_routes(monitor),
+                                readiness=monitor.readiness,
+                                port=port, host=host)
+        self.port = self._ep.port
 
     def start(self) -> None:
-        self._thread.start()
-        self._started = True
+        self._ep.start()
 
     def close(self) -> None:
-        # shutdown() waits on an event only serve_forever() sets: a
-        # never-started server (the duplicate-session error path in
-        # ``start()``) must skip it or close deadlocks forever.
-        if self._started:
-            self._httpd.shutdown()
-            self._thread.join(timeout=5.0)
-        self._httpd.server_close()
+        self._ep.close()
 
 
 # ---------------------------------------------------------------------------

@@ -316,6 +316,50 @@ def test_history_spec_watches_cd_fused():
     assert directions["cd_fused:cd_fused.fused.rows_per_sec"] == "higher"
 
 
+@pytest.mark.fast
+def test_history_spec_watches_serve():
+    """ISSUE 12 satellite: the history metric spec carries the serve
+    section's p99 latency, sustained rows/s, and batch fill."""
+    from photon_ml_tpu.telemetry.history import METRICS
+
+    keys = {(s, p) for s, p, _ in METRICS}
+    assert ("serve", "serve.p99_ms") in keys
+    assert ("serve", "serve.rows_per_sec") in keys
+    assert ("serve", "serve.batch_fill") in keys
+    directions = {f"{s}:{p}": d for s, p, d in METRICS}
+    assert directions["serve:serve.p99_ms"] == "lower"
+    assert directions["serve:serve.rows_per_sec"] == "higher"
+    assert directions["serve:serve.batch_fill"] == "higher"
+
+
+@pytest.mark.slow   # server subprocess + client storm
+def test_bench_serve_section_contract(tmp_path):
+    """`--section serve` keeps the budget/JSON-last-line contract and
+    records the serving measurement: client-observed p50/p99 latency
+    and rows/s under concurrent open-loop clients, micro-batch fill,
+    margin parity vs the batch scorer, the server's own peak RSS, and
+    the server subprocess's clean rc."""
+    proc = _run_bench(tmp_path, "--section", "serve",
+                      "--budget-s", "240", *_TINY)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert rec["section"] == "serve"
+    assert rec.get("errors") is None
+    s = rec["serve"]
+    assert s["clients"] == 4
+    assert s["requests"] > 0
+    assert s["p50_ms"] > 0 and s["p99_ms"] >= s["p50_ms"]
+    assert s["rows_per_sec"] > 0
+    assert 0 < s["batch_fill"] <= 1.0
+    # Served margins match the batch scorer on identical rows
+    # (documented tolerance — same f32 fused program).
+    assert s["margin_parity_max"] <= 1e-5
+    assert s["server_peak_rss_mb"] > 0
+    assert s["server_rc"] == 0
+    assert rec["peak_rss_mb"]["serve"] > 0
+
+
 def test_bench_history_dir_appends_envelope(tmp_path):
     """`--history-dir` appends the run's JSON record as a
     schema-versioned envelope file that `telemetry history` ingests

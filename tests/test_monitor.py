@@ -399,6 +399,57 @@ def test_device_memory_growth_needs_ratio_and_floor():
     m.close()
 
 
+def test_serve_tail_latency_fires_over_threshold_latched():
+    """ISSUE 12 satellite (positive): a request stream whose p99 sits
+    above the threshold fires serve_tail_latency exactly once, stamped
+    with the observed p99."""
+    clock = _FakeClock()
+    reg = _registry(clock)
+    m, sink, _ = _monitor(clock=clock, session=reg)
+    for _ in range(30):
+        reg.count("serve.requests")
+        reg.observe("serve.request_s", 0.9)     # every request slow
+    clock.tick(0.5)
+    m.progress("serve", 30, unit="requests")
+    assert _rules(sink) == ["serve_tail_latency"]
+    alert = sink.of("alert")[0]
+    assert alert["stage"] == "serve"
+    assert alert["p99_ms"] > 500.0
+    # Latched: the next snapshot with the same registry re-fires
+    # nothing.
+    clock.tick(0.5)
+    m.progress("serve", 60, unit="requests")
+    assert _rules(sink) == ["serve_tail_latency"]
+    m.close()
+
+
+def test_serve_tail_latency_negative_paths():
+    """ISSUE 12 satellite (negative): a fast stream never fires, and a
+    slow p99 below the minimum request count is start-up noise, not an
+    SLO breach."""
+    clock = _FakeClock()
+    reg = _registry(clock)
+    m, sink, _ = _monitor(clock=clock, session=reg)
+    for _ in range(200):                        # fast stream
+        reg.count("serve.requests")
+        reg.observe("serve.request_s", 0.005)
+    clock.tick(0.5)
+    m.progress("serve", 200, unit="requests")
+    assert _rules(sink) == []
+    m.close()
+
+    clock2 = _FakeClock()
+    reg2 = _registry(clock2)
+    m2, sink2, _ = _monitor(clock=clock2, session=reg2)
+    for _ in range(5):                          # slow but too few
+        reg2.count("serve.requests")
+        reg2.observe("serve.request_s", 2.0)
+    clock2.tick(0.5)
+    m2.progress("serve", 5, unit="requests")
+    assert _rules(sink2) == []
+    m2.close()
+
+
 def test_alerts_disabled_evaluates_nothing():
     m, sink, clock = _monitor(every_s=0.0, alerts=False)
     for i in range(5):
@@ -645,6 +696,14 @@ def test_status_endpoint_routes():
     try:
         port = m.status_port
         assert port and port > 0
+        # Warming until work flows (ISSUE 12 satellite): before the
+        # first progress snapshot — the plan/compile window — a probe
+        # gets 503, not the old unconditional 200.
+        with pytest.raises(urllib.error.HTTPError) as warm:
+            _get(port, "/healthz")
+        assert warm.value.code == 503
+        assert json.loads(warm.value.read().decode())["state"] == \
+            "warming"
         monitor.progress("sweep", 3, 12, unit="chunks")
         code, ctype, body = _get(port, "/status")
         assert code == 200 and ctype == "application/json"
@@ -657,7 +716,8 @@ def test_status_endpoint_routes():
         assert 'photon_monitor_progress_done{stage="sweep"} 3.0' in body
         assert "photon_monitor_alerts_total 0" in body
         code, _, body = _get(port, "/healthz")
-        assert code == 200 and json.loads(body) == {"ok": True}
+        assert code == 200
+        assert json.loads(body) == {"ok": True, "state": "ready"}
         with pytest.raises(urllib.error.HTTPError) as err:
             _get(port, "/no_such")
         assert err.value.code == 404
