@@ -151,6 +151,17 @@ SERVE_INTERVAL_S = 0.010         # open-loop firing cadence per client
 SERVE_POOL = 512                 # distinct request rows replayed
 SERVE_BATCH_ROWS = 64            # largest micro-batch bucket
 
+# Fleet arm (ISSUE 13): supervisor + 2 replicas behind the frontend,
+# one replica SIGKILLed mid-storm.  Claims under test: zero failed
+# client requests (the frontend's bounded retry-once), the killed
+# replica restarted + re-warmed + back in rotation with the
+# supervisor-measured restart latency, and overload sheds (if any)
+# reported as a fraction, not hidden.
+SERVE_FLEET_REPLICAS = 2
+SERVE_FLEET_REQS_PER_CLIENT = 300
+SERVE_FLEET_INTERVAL_S = 0.020   # open-loop cadence (storm ~6 s)
+SERVE_FLEET_KILL_FRACTION = 0.33  # SIGKILL one replica this far in
+
 # Per-section wall-clock estimates at the FULL bench shape on the
 # measured host (BENCH_r05 tail: etl 123 s, grr measure 346 s, colmajor
 # 305 s, segment_sum 35 s; powerlaw/chunked from the r05 PERF record),
@@ -182,8 +193,10 @@ SECTION_EST_S = {
     "cd_fused": 480.0,
     # One server subprocess (model load + bucket warm-up) + the
     # open-loop client storm (~CLIENTS × REQS × INTERVAL of wall) +
-    # the parent's parity pass over the request pool.
-    "serve": 240.0,
+    # the parent's parity pass over the request pool, then the fleet
+    # arm: 2 replica warm-ups, a ~6 s storm with a mid-run SIGKILL,
+    # and the restart-latency wait.
+    "serve": 420.0,
 }
 
 
@@ -1958,6 +1971,204 @@ def section_serve(ctx: BenchContext) -> None:
           f"{s['rows_per_sec']} rows/s, batch fill {s['batch_fill']}, "
           f"parity {parity:.2e}, server peak RSS "
           f"{s['server_peak_rss_mb']} MB", file=sys.stderr)
+    _serve_fleet_arm(ctx, cfg_path, bodies)
+
+
+def _serve_fleet_arm(ctx: BenchContext, base_cfg_path: str,
+                     bodies: list) -> None:
+    """Fleet arm (ISSUE 13): supervisor + SERVE_FLEET_REPLICAS replica
+    subprocesses behind the frontend; one replica SIGKILLed mid-storm.
+    Reports failed-request count (the retry-once contract says 0),
+    supervisor-measured restart latency, and the shed fraction."""
+    import shutil
+    import signal
+    import subprocess
+    import threading
+    import urllib.error
+    import urllib.request
+
+    budget = ctx.remaining()
+    if budget < 90.0:
+        # No silent caps: a skipped arm is recorded as skipped, not
+        # absent-and-assumed-green.
+        ctx.record["serve"]["fleet"] = {
+            "skipped": f"budget ({budget:.0f}s remaining < 90s)"}
+        print("serve: fleet arm SKIPPED (budget)", file=sys.stderr)
+        return
+
+    with open(base_cfg_path) as f:
+        cfg = json.load(f)
+    cfg.update({
+        "replicas": SERVE_FLEET_REPLICAS,
+        # Tight detection/restart knobs: the measured restart latency
+        # should be dominated by the replica's model load + warm-up,
+        # not the probe cadence.
+        "probe_every_s": 0.25,
+        "probe_timeout_s": 2.0,
+        "restart_backoff_s": 0.25,
+    })
+    fleet_cfg_path = os.path.join(ctx.cache_dir, "serve_fleet.json")
+    with open(fleet_cfg_path, "w") as f:
+        json.dump(cfg, f)
+    fleet_dir = os.path.join(ctx.cache_dir, "fleet")
+    shutil.rmtree(fleet_dir, ignore_errors=True)
+    info_path = os.path.join(ctx.cache_dir, "fleet_info.json")
+    if os.path.exists(info_path):
+        os.remove(info_path)
+    t_start = time.time()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "photon_ml_tpu.serving",
+         "--config", fleet_cfg_path, "--info-file", info_path,
+         "--fleet-dir", fleet_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+
+    def _fail(msg: str):
+        if proc.poll() is None:
+            proc.kill()
+        _out, err = proc.communicate()
+        return RuntimeError(f"serve fleet: {msg}: {(err or '')[-500:]}")
+
+    def get_json(url_: str) -> dict:
+        with urllib.request.urlopen(url_, timeout=10) as r:
+            return json.loads(r.read())
+
+    try:
+        deadline = time.time() + max(60.0, min(budget, 240.0))
+        while not os.path.exists(info_path):
+            if proc.poll() is not None or time.time() > deadline:
+                raise _fail("frontend never wrote its info file")
+            time.sleep(0.05)
+        with open(info_path) as f:
+            url = json.load(f)["url"]
+        while True:     # BOTH replicas warm before the storm
+            if proc.poll() is not None or time.time() > deadline:
+                raise _fail("fleet never became fully ready")
+            try:
+                st = get_json(url + "/status")
+                if st["fleet"]["ready"] == SERVE_FLEET_REPLICAS:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        warm_wait_s = time.time() - t_start
+
+        def post(body: bytes) -> dict:
+            req = urllib.request.Request(
+                url + "/v1/score", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        latencies: list = []
+        errors: list = []
+        client_sheds = [0]
+        lat_lock = threading.Lock()
+
+        def client(c: int) -> None:
+            t0 = time.perf_counter()
+            for j in range(SERVE_FLEET_REQS_PER_CLIENT):
+                target = t0 + j * SERVE_FLEET_INTERVAL_S
+                lag = target - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                body = bodies[(c * 37 + j) % len(bodies)]
+                t1 = time.perf_counter()
+                try:
+                    post(body)
+                except urllib.error.HTTPError as e:
+                    # A 429/503 shed is the DESIGNED overload answer
+                    # (Retry-After), not a failed request — it rides
+                    # the shed fraction, never failed_requests.
+                    with lat_lock:
+                        if e.code in (429, 503):
+                            client_sheds[0] += 1
+                        else:
+                            errors.append(f"HTTP {e.code}")
+                    e.read()
+                    continue
+                except Exception as e:  # noqa: BLE001 - recorded
+                    with lat_lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    continue
+                with lat_lock:
+                    latencies.append(time.perf_counter() - t1)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(SERVE_CLIENTS)]
+        storm_s = SERVE_FLEET_REQS_PER_CLIENT * SERVE_FLEET_INTERVAL_S
+        for t in threads:
+            t.start()
+        # SIGKILL one READY replica mid-storm — the fault the fleet
+        # exists to survive.
+        time.sleep(storm_s * SERVE_FLEET_KILL_FRACTION)
+        st = get_json(url + "/status")
+        victim = next((r for r in st["fleet"]["replicas"]
+                       if r["state"] == "ready" and r["pid"]), None)
+        if victim is None:
+            raise _fail(f"no ready replica to SIGKILL "
+                        f"(fleet: {st['fleet']['replicas']})")
+        os.kill(victim["pid"], signal.SIGKILL)
+        t_kill = time.time()
+        for t in threads:
+            t.join()
+        # The replica must come back: restarted, re-warmed, in
+        # rotation.
+        restart_deadline = time.time() + 120.0
+        while True:
+            st = get_json(url + "/status")
+            if (st["fleet"]["restarts"] >= 1
+                    and st["fleet"]["ready"] == SERVE_FLEET_REPLICAS):
+                break
+            if time.time() > restart_deadline:
+                raise _fail("killed replica never rejoined the fleet")
+            time.sleep(0.2)
+        recovery_wall_s = time.time() - t_kill
+        fe = st["frontend"]
+        shed_total = fe["shed"]
+        served = fe["requests"]
+        shed_fraction = (shed_total / (shed_total + served)
+                         if (shed_total + served) else 0.0)
+        lat = np.asarray(sorted(latencies))
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            stdout, stderr = proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            stdout, stderr = proc.communicate()
+        sys.stderr.write(stderr[-2000:] if stderr else "")
+    if proc.returncode != 0:
+        raise RuntimeError(f"serve fleet: frontend exited rc="
+                           f"{proc.returncode}")
+    final = json.loads(
+        [ln for ln in stdout.splitlines() if ln.strip()][-1])
+
+    s = ctx.record["serve"]
+    # History-gated claims ride at the serve.* top level.
+    s["failed_requests"] = len(errors)
+    s["restart_s"] = st["fleet"]["last_restart_s"]
+    s["shed_fraction"] = round(shed_fraction, 4)
+    s["fleet"] = {
+        "replicas": SERVE_FLEET_REPLICAS,
+        "requests": int(len(lat)),
+        "client_sheds": client_sheds[0],
+        "errors": errors[:5],
+        "warm_wait_s": round(warm_wait_s, 2),
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+        "retries": fe["retries"],
+        "shed": shed_total,
+        "restarts": st["fleet"]["restarts"],
+        "recovery_wall_s": round(recovery_wall_s, 2),
+        "frontend_rc": final["rc"],
+    }
+    print(f"serve fleet: {SERVE_FLEET_REPLICAS} replicas, SIGKILL at "
+          f"{SERVE_FLEET_KILL_FRACTION:.0%}: failed "
+          f"{s['failed_requests']}, retries {fe['retries']}, restart "
+          f"{s['restart_s']}s (recovery wall {recovery_wall_s:.1f}s), "
+          f"shed fraction {s['shed_fraction']}, p99 "
+          f"{s['fleet']['p99_ms']} ms", file=sys.stderr)
 
 
 SECTION_FNS = {

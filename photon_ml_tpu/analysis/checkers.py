@@ -32,6 +32,12 @@ established by hand and a later tier could silently regress:
   discarded
   (ISSUE 9: fault tolerance is only honest when every absorbed failure
   is reported, handled with a real fallback, or waived with a reason).
+- ``eternal-wait``: in a thread-spawning class, a blocking wait with
+  no timeout — ``queue.get()``, ``Event.wait()``, ``Thread.join()``,
+  ``socket.recv()`` — can pin a thread forever when its peer dies
+  (ISSUE 13: the serving tier's wedged-handler class of outage).
+  Every cross-thread wait must be bounded, or waived with the reason
+  the block is provably terminated (e.g. a close() sentinel).
 - ``slow-unmarked``: tests whose recorded tier-1 duration exceeds the
   threshold must carry ``@pytest.mark.slow`` so the tier-1 wall clock
   stops creeping (durations recorded once in
@@ -101,6 +107,12 @@ RULES = {
         "except handler silently discards the failure (pass/continue/"
         "break/bare return) without re-raising or logging — waiver "
         "with reason for deliberate best-effort sites"
+    ),
+    "eternal-wait": (
+        "unbounded blocking wait (queue.get()/Event.wait()/"
+        "Thread.join()/socket.recv() with no timeout) in a "
+        "thread-spawning class — a dead peer pins the thread forever; "
+        "bound it or waive with the termination argument"
     ),
     "slow-unmarked": (
         "test measured slower than the threshold lacks "
@@ -960,6 +972,70 @@ def check_swallowed_exception(ctx: _FileContext):
 
 
 # ---------------------------------------------------------------------------
+# Rule: eternal-wait
+# ---------------------------------------------------------------------------
+
+# Zero-argument blocking calls that wait forever without a timeout.
+# The zero-arg requirement keeps dict.get(key) / str.join(seq) /
+# path.join(a, b) out by construction: the flagged shapes are
+# queue.Queue.get(), threading.Event.wait() / Condition.wait(), and
+# Thread.join().
+_ETERNAL_ZERO_ARG = ("get", "wait", "join")
+
+
+def _has_timeout_kw(call: ast.Call) -> bool:
+    return any(kw.arg in ("timeout", "timeout_s") and not (
+        isinstance(kw.value, ast.Constant) and kw.value.value is None)
+        for kw in call.keywords)
+
+
+def check_eternal_wait(ctx: _FileContext):
+    """A thread-spawning class owns at least one cross-thread wait; a
+    wait with NO timeout turns a dead peer into a silently pinned
+    thread (the wedged-replica outage class, ISSUE 13).  Flags
+    ``.get()`` / ``.wait()`` / ``.join()`` calls with neither a
+    positional argument nor a timeout keyword, and ``.recv(...)``
+    (socket reads — the timeout lives in ``settimeout``, which static
+    analysis cannot prove was called) inside classes that construct
+    ``threading.Thread`` / ``ThreadPoolExecutor``.  Deliberately
+    unbounded waits (a ``close()`` that provably enqueues a sentinel,
+    a main thread parked on a stop event) carry a waiver naming the
+    termination argument."""
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        spawns = any(
+            isinstance(n, ast.Call)
+            and _dotted(n.func) in _THREAD_CTORS + _POOL_CTORS
+            for n in ast.walk(cls))
+        if not spawns:
+            continue
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call) or not isinstance(
+                    node.func, ast.Attribute):
+                continue
+            name = node.func.attr
+            if name in _ETERNAL_ZERO_ARG:
+                if node.args or _has_timeout_kw(node):
+                    continue
+                recv = _dotted(node.func.value) or "<expr>"
+                yield Violation(
+                    ctx.path, node.lineno, "eternal-wait",
+                    f"{recv}.{name}() blocks with no timeout in "
+                    f"thread-spawning class '{cls.name}': a dead peer "
+                    "pins this thread forever — pass a timeout (poll) "
+                    "or waive with the termination argument")
+            elif name == "recv" and not _has_timeout_kw(node):
+                recv = _dotted(node.func.value) or "<expr>"
+                yield Violation(
+                    ctx.path, node.lineno, "eternal-wait",
+                    f"{recv}.recv() in thread-spawning class "
+                    f"'{cls.name}': socket reads block forever unless "
+                    "settimeout() was called — set one (or waive "
+                    "naming where the timeout is applied)")
+
+
+# ---------------------------------------------------------------------------
 # Rule: slow-unmarked (repo-level: needs the recorded durations)
 # ---------------------------------------------------------------------------
 
@@ -1049,6 +1125,7 @@ _FILE_CHECKERS = (
     check_naked_clock,
     check_metric_name,
     check_swallowed_exception,
+    check_eternal_wait,
 )
 
 

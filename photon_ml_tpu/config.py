@@ -632,6 +632,37 @@ class ServingConfig:
     monitor_every_s: float = 2.0
     status_port: int | None = None   # unused: /status rides the port
     log_path: str | None = None      # run-log JSONL (default: stderr)
+    # --- Resilient fleet (ISSUE 13) ------------------------------------
+    # replicas > 1 runs the supervised fleet: N replica ModelServer
+    # subprocesses behind one health-routed frontend (serving.fleet /
+    # serving.frontend).  The frontend binds `port`; replicas take
+    # ephemeral ports and are restarted on crash or wedge.
+    replicas: int = 1
+    # Health probing: each replica's /healthz is polled every
+    # probe_every_s with probe_timeout_s per probe; unhealthy_after
+    # consecutive failed probes on a live process mark it wedged (it is
+    # killed and restarted like a crash).
+    probe_every_s: float = 0.5
+    probe_timeout_s: float = 2.0
+    unhealthy_after: int = 3
+    # Restart policy: bounded exponential backoff between restarts of
+    # the same replica (base doubling, capped), and a circuit breaker —
+    # breaker_threshold restarts inside breaker_window_s opens the
+    # breaker for breaker_reset_s (no restarts), after which ONE
+    # half-open attempt either closes it (replica reaches ready) or
+    # re-opens it.
+    restart_backoff_s: float = 0.5
+    restart_backoff_max_s: float = 10.0
+    breaker_threshold: int = 5
+    breaker_window_s: float = 30.0
+    breaker_reset_s: float = 30.0
+    # A (re)spawned replica that has not reached ready within this
+    # budget counts as a failed start (killed, backoff applies).
+    replica_ready_timeout_s: float = 300.0
+    # Per-connection socket timeout on the HTTP cores (frontend and
+    # replicas): a stalled client is disconnected instead of pinning a
+    # handler thread forever.
+    http_timeout_s: float = 30.0
 
     def validate(self) -> None:
         if not self.model_dir:
@@ -668,6 +699,29 @@ class ServingConfig:
         if self.telemetry not in ("off", "metrics", "trace"):
             raise ValueError("telemetry must be off|metrics|trace")
         _validate_monitor(self)
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if self.probe_every_s <= 0:
+            raise ValueError("probe_every_s must be positive")
+        if self.probe_timeout_s <= 0:
+            raise ValueError("probe_timeout_s must be positive")
+        if self.unhealthy_after < 1:
+            raise ValueError("unhealthy_after must be >= 1")
+        if self.restart_backoff_s < 0:
+            raise ValueError("restart_backoff_s must be >= 0")
+        if self.restart_backoff_max_s < self.restart_backoff_s:
+            raise ValueError(
+                "restart_backoff_max_s must be >= restart_backoff_s")
+        if self.breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
+        if self.breaker_window_s <= 0:
+            raise ValueError("breaker_window_s must be positive")
+        if self.breaker_reset_s <= 0:
+            raise ValueError("breaker_reset_s must be positive")
+        if self.replica_ready_timeout_s <= 0:
+            raise ValueError("replica_ready_timeout_s must be positive")
+        if self.http_timeout_s <= 0:
+            raise ValueError("http_timeout_s must be positive")
 
     def buckets(self) -> list[int]:
         """The closed micro-batch shape set, smallest first."""

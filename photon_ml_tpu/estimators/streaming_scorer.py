@@ -145,6 +145,7 @@ class _SinkWriter:
         visible in the run log."""
         t = telemetry.active()
         if t is None:
+            # photon-lint: disable=eternal-wait (close() always enqueues the sentinel, and put() runs on the producer that also calls close(); the get is bounded by shutdown)
             return self._q.get()
         start = time.perf_counter()
         beat = start
@@ -202,7 +203,14 @@ class _SinkWriter:
 
     def close(self) -> None:
         self._q.put(self._SENTINEL)
-        self._thread.join()
+        # Bounded drain (photon-lint eternal-wait): a sink wedged in a
+        # hung filesystem write must surface as an actionable error,
+        # not pin close() forever.
+        self._thread.join(timeout=600.0)
+        if self._thread.is_alive():
+            raise RuntimeError(
+                "score sink writer did not drain within 600s (sink "
+                "write wedged); output containers may be incomplete")
         err = self._failed()
         if err is not None:
             raise err

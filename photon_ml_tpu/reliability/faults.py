@@ -14,6 +14,19 @@ seams where they occur in production:
   thread around the disk-read and device_put stages.
 - ``sink.write`` — fired on the score sink-writer thread per chunk.
 
+The serving fault matrix (ISSUE 13) adds the request-path seams:
+
+- ``serve.store_load`` — fired in ``EntityServeStore`` per chunk read
+  on the scoring hot path (slow store, transient/persistent I/O →
+  retries then fixed-effect-only degradation).
+- ``serve.dispatch`` — fired in ``ScoringEngine.score_batch`` before
+  the fused device dispatch (wedged/failing device → answered error
+  for the whole batch, never a hang).
+- ``serve.manifest_load`` — fired in ``ModelServer._load_engine``
+  with the manifest path (corrupt/torn swap → keep previous model).
+- ``serve.replica_healthz`` — fired in the fleet supervisor's probe
+  (flaky/wedged health probe → unhealthy-replica restart policy).
+
 A ``FaultInjector`` holds a list of ``Fault`` specs, each targeting a
 site's Nth occurrence (per-site occurrence counters under one lock, so
 multi-threaded sites count deterministically given a deterministic

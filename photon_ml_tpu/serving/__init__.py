@@ -18,6 +18,13 @@ process that keeps the fused scoring program warm and answers
 - ``serving.server``: ``ModelServer`` — checkpoint-manifest load,
   bucket warm-up, hot model swap, the HTTP surface; run it with
   ``python -m photon_ml_tpu.serving --config serve.json``.
+- ``serving.fleet`` / ``serving.frontend`` (ISSUE 13): the resilient
+  tier — a supervisor spawning N replica ``ModelServer`` subprocesses
+  (healthz-probed, restarted with backoff + circuit breaker, rolled
+  one at a time on a new manifest) behind one health-routed frontend
+  (least-outstanding routing, bounded retry-once, overload shedding,
+  aggregated fleet ``/status``); ``replicas > 1`` in the config runs
+  it from the same CLI.
 """
 
 # NOTE: no eager submodule imports — ``telemetry.monitor`` imports the
@@ -30,4 +37,8 @@ def __getattr__(name: str):
         from photon_ml_tpu.serving.server import ModelServer
 
         return ModelServer
+    if name == "FleetServer":
+        from photon_ml_tpu.serving.fleet import FleetServer
+
+        return FleetServer
     raise AttributeError(name)

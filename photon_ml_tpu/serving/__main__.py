@@ -6,6 +6,13 @@ gracefully (queued requests finish, then the endpoint closes).  The
 last stdout line is one JSON object (the repo's CLI contract) carrying
 the final serving status — requests, swaps, peak RSS.
 
+With ``replicas > 1`` (config or ``--replicas``) the process runs the
+SUPERVISED FLEET instead (ISSUE 13): N replica server subprocesses
+behind one health-routed frontend — the frontend binds the configured
+port, replicas take ephemeral ports and are restarted on crash/wedge
+with backoff + circuit breaker, and a newly published manifest rolls
+through the replicas one at a time.
+
 ``--info-file`` writes ``{"port", "pid", "url"}`` as soon as the
 socket binds (atomic tmp + replace), so a supervisor or the bench's
 client harness can discover an ephemeral port and poll ``/healthz``
@@ -21,7 +28,6 @@ import signal
 import sys
 
 from photon_ml_tpu.config import load_serving_config
-from photon_ml_tpu.serving.server import ModelServer
 from photon_ml_tpu.utils.run_log import DEFAULT_FLUSH_EVERY_S, RunLogger
 
 
@@ -41,12 +47,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--hot-swap-poll-s", type=float, default=None,
                    dest="hot_swap_poll_s",
                    help="override config hot_swap_poll_s (0 = off)")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="override config replicas (>1 = supervised "
+                        "fleet behind one frontend)")
+    p.add_argument("--fleet-dir", default=None,
+                   help="fleet workdir (replica configs/logs/info "
+                        "files; default: a temp dir)")
     p.add_argument("--info-file", default=None,
                    help="write {port, pid, url} JSON here once the "
                         "socket binds (atomic)")
     args = p.parse_args(argv)
     config = load_serving_config(args.config)
-    for name in ("port", "model_dir", "spill_dir", "hot_swap_poll_s"):
+    for name in ("port", "model_dir", "spill_dir", "hot_swap_poll_s",
+                 "replicas"):
         val = getattr(args, name)
         if val is not None:
             setattr(config, name, val)
@@ -54,9 +67,18 @@ def main(argv: list[str] | None = None) -> int:
 
     log = RunLogger(config.log_path,
                     run_info={"driver": "serving",
-                              "model_dir": config.model_dir},
+                              "model_dir": config.model_dir,
+                              "replicas": config.replicas},
                     flush_every_s=DEFAULT_FLUSH_EVERY_S)
-    server = ModelServer(config, run_logger=log)
+    if config.replicas > 1:
+        from photon_ml_tpu.serving.fleet import FleetServer
+
+        server = FleetServer(config, run_logger=log,
+                             workdir=args.fleet_dir)
+    else:
+        from photon_ml_tpu.serving.server import ModelServer
+
+        server = ModelServer(config, run_logger=log)
     if args.info_file:
         info = {"port": server.port, "pid": os.getpid(),
                 "url": f"http://{config.host}:{server.port}"}

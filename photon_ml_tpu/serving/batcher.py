@@ -14,6 +14,24 @@ at warm-up), so the steady state never compiles.  A request larger
 than the biggest bucket splits across several dispatches and
 reassembles transparently.
 
+Overload shedding (ISSUE 13 tentpole): queuing a request that cannot
+meet its deadline only converts a fast failure into a slow one AND
+drags every admitted request's tail with it (queue collapse).  Two
+guards keep the admitted tail bounded:
+
+- **Admission control**: ``submit`` estimates the queue wait from the
+  rows already queued and the rolling (EWMA) batch service time; a
+  request whose estimated start lies beyond its deadline budget is
+  shed immediately with ``ServerOverloaded`` (HTTP 503 +
+  ``Retry-After``) instead of queued to die.
+- **Expiry at dispatch**: a queued slot whose deadline has already
+  passed when the dispatcher reaches it is failed with
+  ``DeadlineExceeded`` (503) rather than spending device time on an
+  answer its client stopped waiting for.
+
+Both sheds count ``serve.shed`` (plus a per-cause counter), the signal
+the monitor's ``serve_shed_rate`` rule watches.
+
 Hot swap: the batcher holds NO model state — every dispatch fetches
 the current engine through ``engine_fn`` at batch-formation time, so a
 swap lands between batches by construction: in-flight batches finish
@@ -29,6 +47,7 @@ the stats endpoint mutate under one lock.
 from __future__ import annotations
 
 import logging
+import math
 import queue
 import threading
 import time
@@ -40,6 +59,11 @@ from photon_ml_tpu.telemetry import monitor as _mon
 
 logger = logging.getLogger(__name__)
 
+# EWMA weight for the rolling batch service time (the admission
+# estimator): ~last 5 batches dominate, so the estimate tracks load
+# shifts within a second at serving batch rates.
+_SERVICE_EWMA = 0.2
+
 
 class ServerClosing(RuntimeError):
     """Submitted while the server is draining (HTTP 503)."""
@@ -49,27 +73,54 @@ class ServerSaturated(RuntimeError):
     """The request queue is full (HTTP 429): shed load instead of
     queueing into timeout."""
 
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class ServerOverloaded(RuntimeError):
+    """Admission control shed: the estimated queue wait exceeds the
+    request's deadline budget (HTTP 503 + Retry-After)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed while it sat in the queue
+    (HTTP 503 + Retry-After): the batcher refuses to spend device time
+    on an answer the client has stopped waiting for."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
 
 class _Slot:
     """One request's result hand-off (condition-guarded)."""
 
-    __slots__ = ("rows", "n", "_cv", "_done", "result", "error",
-                 "version")
+    __slots__ = ("rows", "n", "deadline", "_cv", "_done", "result",
+                 "error", "version", "degraded")
 
-    def __init__(self, rows, n: int):
+    def __init__(self, rows, n: int, deadline: float = math.inf):
         self.rows = rows
         self.n = n
+        self.deadline = deadline     # batcher-clock time; inf = none
         self._cv = threading.Condition()
         self._done = False
         self.result = None       # (margins, preds) slices
         self.error: BaseException | None = None
         self.version: str | None = None
+        self.degraded = False
 
-    def finish(self, result=None, error=None, version=None) -> None:
+    def finish(self, result=None, error=None, version=None,
+               degraded: bool = False) -> None:
         with self._cv:
             self.result = result
             self.error = error
             self.version = version
+            self.degraded = degraded
             self._done = True
             self._cv.notify_all()
 
@@ -81,7 +132,7 @@ class _Slot:
                     "(server overloaded or wedged)")
         if self.error is not None:
             raise self.error
-        return self.result, self.version
+        return self.result, self.version, self.degraded
 
 
 class MicroBatcher:
@@ -116,18 +167,42 @@ class MicroBatcher:
         self.batches = 0
         self.rows = 0
         self.padded_rows = 0
+        self.shed = 0                  # all sheds (saturated/overload/
+        self._queued_rows = 0          # ...deadline-expired)
+        self._service_ewma_s: float | None = None
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="photon-serve-batcher")
         self._thread.start()
 
     # -- client side ---------------------------------------------------------
 
+    def _estimated_wait_s(self, extra_rows: int) -> float | None:
+        """Estimated queue delay before a request enqueued NOW (behind
+        ``_queued_rows`` + its own ``extra_rows``) finishes, from the
+        rolling batch service time.  None while cold (no batch has
+        been measured — admission never sheds blind).  Caller holds
+        the lock."""
+        if self._service_ewma_s is None:
+            return None
+        batches_ahead = math.ceil(
+            (self._queued_rows + extra_rows) / self.max_rows)
+        return batches_ahead * self._service_ewma_s
+
+    def _shed(self, cause: str) -> None:
+        with self._lock:
+            self.shed += 1
+        telemetry.count("serve.shed")
+        telemetry.count(f"serve.shed_{cause}")
+
     def submit(self, parsed_rows: list, timeout_s: float = 30.0):
-        """Block until scored: → (margins [n], preds [n], version).
-        Called from HTTP handler threads; oversized requests split
-        across ≤max_rows slots and reassemble here."""
+        """Block until scored: → (margins [n], preds [n], version,
+        degraded).  Called from HTTP handler threads; oversized
+        requests split across ≤max_rows slots and reassemble here."""
         t0 = time.perf_counter()
+        deadline = self._clock() + timeout_s
         slots = []
+        shed_exc: Exception | None = None
+        shed_cause = None
         # Enqueue UNDER the closing lock (put_nowait never blocks, so
         # holding it is safe): close() sets _closing and appends the
         # drain sentinel under the same lock, so no slot can ever land
@@ -135,28 +210,54 @@ class MicroBatcher:
         with self._lock:
             if self._closing:
                 raise ServerClosing("server is draining")
-            for lo in range(0, len(parsed_rows), self.max_rows):
-                piece = parsed_rows[lo: lo + self.max_rows]
-                if self._q.qsize() >= self.max_queue:
-                    # Shed load; requests already queued from this
-                    # submit still score (their slots just get
-                    # abandoned results).
-                    raise ServerSaturated(
-                        f"request queue full ({self.max_queue}); "
-                        "shed load or raise max_queue")
-                slot = _Slot(piece, len(piece))
-                self._q.put(slot)
-                slots.append(slot)
+            est = self._estimated_wait_s(len(parsed_rows))
+            if est is not None and est > timeout_s:
+                # Deadline-aware admission control: this request would
+                # time out in the queue — shed NOW with a 503 +
+                # Retry-After instead of queuing it to die (and
+                # dragging every admitted request's tail with it).
+                self.shed += 1
+                shed_exc = ServerOverloaded(
+                    f"estimated queue wait {est:.2f}s exceeds the "
+                    f"request deadline budget {timeout_s:g}s; retry "
+                    "after backoff or raise capacity",
+                    retry_after_s=max(1.0, est - timeout_s))
+                shed_cause = "overload"
+            else:
+                for lo in range(0, len(parsed_rows), self.max_rows):
+                    piece = parsed_rows[lo: lo + self.max_rows]
+                    if self._q.qsize() >= self.max_queue:
+                        # Shed load; requests already queued from this
+                        # submit still score (their slots just get
+                        # abandoned results).
+                        self.shed += 1
+                        shed_exc = ServerSaturated(
+                            f"request queue full ({self.max_queue}); "
+                            "shed load or raise max_queue",
+                            retry_after_s=max(1.0, est or 1.0))
+                        shed_cause = "saturated"
+                        break
+                    slot = _Slot(piece, len(piece), deadline=deadline)
+                    self._q.put(slot)
+                    self._queued_rows += len(piece)
+                    slots.append(slot)
+        if shed_exc is not None:
+            telemetry.count("serve.shed")
+            telemetry.count(f"serve.shed_{shed_cause}")
+            raise shed_exc
         telemetry.gauge("serve.queue_depth", self._q.qsize())
         margins, preds, version = [], [], None
+        degraded = False
         for slot in slots:
-            (m, p), version = slot.wait(timeout_s)
+            (m, p), version, deg = slot.wait(timeout_s)
+            degraded = degraded or deg
             margins.append(m)
             preds.append(p)
         dt = time.perf_counter() - t0
         telemetry.count("serve.requests")
         telemetry.observe("serve.request_s", dt)
-        return (np.concatenate(margins), np.concatenate(preds), version)
+        return (np.concatenate(margins), np.concatenate(preds), version,
+                degraded)
 
     # -- dispatcher ----------------------------------------------------------
 
@@ -166,13 +267,39 @@ class MicroBatcher:
                 return b
         return self.max_rows
 
+    def _pop_accounted(self, timeout=None):
+        """Queue pop that keeps ``_queued_rows`` honest."""
+        # photon-lint: disable=eternal-wait (drain contract: close() always enqueues the sentinel under the submit lock, so the unbounded get is terminated by shutdown)
+        item = self._q.get() if timeout is None \
+            else self._q.get(timeout=timeout)
+        if item is not self._SENTINEL:
+            with self._lock:
+                self._queued_rows -= item.n
+        return item
+
+    def _expired(self, slot) -> bool:
+        """Fail a queued slot whose deadline has already passed (shed
+        at dispatch): its client is gone or about to give up — device
+        time spent on it is pure waste under overload."""
+        if self._clock() <= slot.deadline:
+            return False
+        self._shed("deadline")
+        slot.finish(error=DeadlineExceeded(
+            "request deadline passed while queued (server overloaded); "
+            "retry after backoff"))
+        return True
+
     def _run(self) -> None:
         carry = None
         while True:
-            item = carry if carry is not None else self._q.get()
-            carry = None
+            if carry is not None:
+                item, carry = carry, None
+            else:
+                item = self._pop_accounted()
             if item is self._SENTINEL:
                 return
+            if self._expired(item):
+                continue
             batch = [item]
             total = item.n
             deadline = self._clock() + self.deadline_s
@@ -181,12 +308,14 @@ class MicroBatcher:
                 if wait <= 0:
                     break
                 try:
-                    nxt = self._q.get(timeout=wait)
+                    nxt = self._pop_accounted(timeout=wait)
                 except queue.Empty:  # photon-lint: disable=swallowed-exception (the deadline expiring IS the dispatch signal, not a failure)
                     break
                 if nxt is self._SENTINEL:
                     carry = nxt        # dispatch, then exit next loop
                     break
+                if self._expired(nxt):
+                    continue
                 if total + nxt.n > self.max_rows:
                     carry = nxt        # opens the next batch
                     break
@@ -203,12 +332,16 @@ class MicroBatcher:
             # request in flight.
             engine = self._engine_fn()
             rows = [r for slot in batch for r in slot.rows]
-            margins, preds = engine.score_batch(rows, bucket)
+            margins, preds, degraded = engine.score_batch(rows, bucket)
             lo = 0
             for slot in batch:
                 hi = lo + slot.n
+                # Per-slot degraded attribution: only the requests
+                # whose OWN rows were served fallback get the flag —
+                # a co-batched healthy request must not be marked.
                 slot.finish(result=(margins[lo:hi], preds[lo:hi]),
-                            version=engine.version)
+                            version=engine.version,
+                            degraded=bool(np.any(degraded[lo:hi])))
                 lo = hi
         except BaseException as e:
             telemetry.thread_exception("serve-batcher", e)
@@ -216,10 +349,17 @@ class MicroBatcher:
                 slot.finish(error=e)
             return
         finally:
+            dt = time.perf_counter() - t0
             with self._lock:
                 self.batches += 1
                 self.rows += total
                 self.padded_rows += bucket
+                # Rolling batch service time — the admission
+                # estimator's denominator.
+                self._service_ewma_s = dt if self._service_ewma_s \
+                    is None else ((1 - _SERVICE_EWMA)
+                                  * self._service_ewma_s
+                                  + _SERVICE_EWMA * dt)
         telemetry.count("serve.batches")
         telemetry.count("serve.batch_rows", total)
         telemetry.observe("serve.batch_fill", total / bucket)
@@ -237,12 +377,16 @@ class MicroBatcher:
 
     def stats(self) -> dict:
         with self._lock:
-            batches, rows, padded = (self.batches, self.rows,
-                                     self.padded_rows)
+            batches, rows, padded, shed = (self.batches, self.rows,
+                                           self.padded_rows, self.shed)
+            ewma = self._service_ewma_s
         return {
             "batches": batches, "rows": rows,
             "queue_depth": self._q.qsize(),
             "batch_fill": (round(rows / padded, 4) if padded else None),
+            "shed": shed,
+            "service_ewma_ms": (None if ewma is None
+                                else round(ewma * 1e3, 3)),
             "buckets": list(self.buckets),
             "deadline_ms": round(self.deadline_s * 1e3, 3),
         }

@@ -249,10 +249,13 @@ class ScoringEngine:
                 for _ in range(n)]
 
     def _build_chunk(self, rows: list[ParsedRow], R: int
-                     ) -> tuple[dict, dict]:
-        """(chunk arrays, per-batch tables) for ``rows`` padded to
-        ``R`` — all host numpy; placement is the caller's explicit
-        ``device_put``."""
+                     ) -> tuple[dict, dict, np.ndarray]:
+        """(chunk arrays, per-batch tables, degraded [n] bool) for
+        ``rows`` padded to ``R`` — all host numpy; placement is the
+        caller's explicit ``device_put``.  ``degraded[i]`` marks row i
+        served fixed-effect-only fallback by an entity store
+        (ISSUE 13) — per row, so co-batched healthy requests stay
+        unmarked."""
         n = len(rows)
         k = self.ell_row_capacity
         base = np.zeros(R, np.float32)
@@ -281,9 +284,11 @@ class ScoringEngine:
         for name, shard in self._fixed_dense:
             chunk[name + ".x"] = ell[shard]
         batch_tables: dict = {}
+        degraded = np.zeros(n, bool)
         for name, shard, key, store in self._re:
             ids = np.fromiter((r.ids[key] for r in rows), np.int64, n)
-            w_rows, _hit = store.lookup(ids)
+            w_rows, _hit, deg = store.lookup(ids)
+            degraded |= deg
             # Mini-table: row i serves request-row i; row R is the
             # shared zero fallback (unseen entities + padding) — the
             # batch path's unseen-entity semantics, bitwise.
@@ -314,16 +319,25 @@ class ScoringEngine:
 
             base[:n] += _score_projected_rows(comp, table, idx, srows)
         chunk["base"] = base
-        return chunk, batch_tables
+        return chunk, batch_tables, degraded
 
     def score_batch(self, rows: list[ParsedRow], bucket: int
-                    ) -> tuple[np.ndarray, np.ndarray]:
+                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Score ``rows`` padded to ``bucket`` → (margins [n],
-        predictions [n]) as host numpy.  One fused device dispatch."""
+        predictions [n], degraded [n] bool) as host numpy.  One fused
+        device dispatch; ``degraded`` marks the fixed-effect-only
+        fallback rows from an unreadable entity-store chunk
+        (ISSUE 13)."""
+        from photon_ml_tpu.reliability import faults
+
         n = len(rows)
         if n > bucket:
             raise ValueError(f"{n} rows > bucket {bucket}")
-        chunk, batch_tables = self._build_chunk(rows, bucket)
+        chunk, batch_tables, degraded = self._build_chunk(rows, bucket)
+        # The engine-dispatch fault seam: a wedged/failing device
+        # dispatch is injectable here (the batcher maps the error to
+        # the whole batch's slots — an answered 500, never a hang).
+        faults.fire("serve.dispatch", bucket=bucket)
         # Explicit placement + harvest (the no_implicit_transfers
         # contract): the batch chunk and the RE mini-tables go up in
         # one planned device_put; margins/preds come back in one
@@ -335,7 +349,7 @@ class ScoringEngine:
         m_dev, p_dev = _run_chunk(self.specs, self._mean, tables, buf)
         m = np.asarray(jax.device_get(m_dev)[:n])
         p = np.asarray(jax.device_get(p_dev)[:n])
-        return m, p
+        return m, p, degraded
 
     def warm(self, buckets: list[int]) -> dict:
         """Compile (or warm-load from the persistent XLA cache) every

@@ -28,6 +28,15 @@ round-8 disk tier instead (ISSUE 12 tentpole):
 Without a (writable) spill dir the store degrades to a host-resident
 table with one warning — the disk tier is an optimization for the big-E
 regime, never a correctness dependency (the ``probe_spill_dir`` rule).
+
+Request-path resilience (ISSUE 13): a chunk read failure on the
+serving hot path retries through ``reliability.retry`` (bounded
+exponential backoff, transient errnos only) and then DEGRADES — the
+affected rows are served as zeros, i.e. fixed-effect-only scoring,
+exactly the unseen-entity semantics — with ``serve.store_degraded``
+counted and the response marked ``degraded`` instead of failing the
+request with a 500.  The ``serve.store_load`` fault seam makes this
+path deterministically testable.
 """
 
 from __future__ import annotations
@@ -89,6 +98,7 @@ class EntityServeStore:
         self.n_entities = int(len(ids))
         self.lookups = 0
         self.misses = 0                  # unseen-entity rows served
+        self.degraded_lookups = 0        # rows served fixed-effect-only
 
     @property
     def spilled(self) -> bool:
@@ -165,15 +175,40 @@ class EntityServeStore:
             n_chunks - len(missing), store.host_max_resident)
         return cls(name, ids_view, dim, C, store, None)
 
+    def _chunk_rows(self, c: int):
+        """One chunk's decoded coefficient table, through the serving
+        fault seam and the bounded-retry policy (transient OSErrors
+        back off and retry; everything else propagates to the caller's
+        degradation fallback)."""
+        from photon_ml_tpu.reliability import faults
+        from photon_ml_tpu.reliability.retry import run_with_retries
+
+        def attempt():
+            faults.fire("serve.store_load", chunk=c, store=self.name)
+            return self._store.get(c)["w"]
+
+        return run_with_retries(
+            attempt, label=f"serve store '{self.name}' chunk {c}",
+            retry_counter="serve.store_retries",
+            gave_up_counter="serve.store_gave_up")
+
     def lookup(self, query_ids: np.ndarray
-               ) -> tuple[np.ndarray, np.ndarray]:
-        """(rows [m, p] float32, hit [m] bool) for ``query_ids``.
-        Misses (unseen entities) come back as zero rows."""
+               ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(rows [m, p] float32, hit [m] bool, degraded [m] bool) for
+        ``query_ids``.  Misses (unseen entities) come back as zero
+        rows.  ``degraded[i]`` is True when row i's coefficient chunk
+        could not be read (after bounded retries): that row is served
+        as zeros — graceful degradation to fixed-effect-only scoring
+        (the unseen-entity semantics) instead of a failed request —
+        and the PER-ROW mask lets the batcher mark only the requests
+        actually affected, not every request co-batched with them.
+        The store stays up; a later lookup retries the chunk."""
         query_ids = np.asarray(query_ids)
         m = len(query_ids)
         g = sorted_id_join(np.asarray(self._ids), query_ids)
         hit = g >= 0
         out = np.zeros((m, self.dim), np.float32)
+        degraded = np.zeros(m, bool)
         self.lookups += m
         n_miss = int(m - hit.sum())
         if n_miss:
@@ -181,21 +216,36 @@ class EntityServeStore:
             telemetry.count("serve.entity_misses", n_miss)
         if self._table is not None:
             out[hit] = self._table[g[hit]]
-            return out, hit
+            return out, hit, degraded
         gh = g[hit]
         rows_out = np.nonzero(hit)[0]
         for c in np.unique(gh // self.entity_chunk):
             sel = (gh // self.entity_chunk) == c
-            w = self._store.get(int(c))["w"]
+            try:
+                w = self._chunk_rows(int(c))
+            except Exception as e:
+                # Fixed-effect-only fallback: the rows this chunk
+                # would have served stay zero — exactly how an unseen
+                # entity scores — and those rows are marked degraded
+                # instead of failing the request with a 500.
+                degraded[rows_out[sel]] = True
+                self.degraded_lookups += int(sel.sum())
+                telemetry.count("serve.store_degraded")
+                logger.warning(
+                    "entity serve store '%s': chunk %d unreadable "
+                    "(%r); serving fixed-effect-only for %d row(s)",
+                    self.name, int(c), e, int(sel.sum()))
+                continue
             # Fancy-indexing a memmap copies just the touched rows —
             # the batch's working set, not the chunk.
             out[rows_out[sel]] = w[gh[sel] - int(c) * self.entity_chunk]
-        return out, hit
+        return out, hit, degraded
 
     def stats(self) -> dict:
         st = {"name": self.name, "entities": self.n_entities,
               "dim": self.dim, "spilled": self.spilled,
-              "lookups": self.lookups, "misses": self.misses}
+              "lookups": self.lookups, "misses": self.misses,
+              "degraded_lookups": self.degraded_lookups}
         if self._store is not None:
             st.update({"chunk_loads": self._store.loads,
                        "window_hits": self._store.hits,

@@ -1,0 +1,268 @@
+"""Health-routed fleet frontend (ISSUE 13 tentpole).
+
+One HTTP surface over the supervised replica fleet
+(``serving.fleet``): clients talk to the frontend exactly as they
+would to a single ``ModelServer`` — same ``POST /v1/score`` wire
+shape, same ``/healthz`` readiness semantics — and the frontend owns
+the fleet-level resilience:
+
+- **Health routing**: requests go to the READY replica with the
+  fewest outstanding requests (least-outstanding beats round-robin
+  under heterogeneous batch latencies).  Draining/broken/starting
+  replicas receive nothing.
+- **Bounded retry**: a CONNECTION-level failure (refused, reset,
+  timeout, torn response — the replica never answered) retries
+  exactly ONCE on a DIFFERENT ready replica, inside the request's
+  remaining deadline budget.  An HTTP response from a replica — any
+  status — is forwarded verbatim, never retried: scoring is
+  idempotent so the one retry is safe, but an answered error is the
+  replica's verdict.
+- **Shedding**: no ready replica → immediate 503 + Retry-After;
+  replica sheds (429/503 from admission control) forward with their
+  Retry-After and count into the frontend's ``serve.shed`` — the
+  monitor's ``serve_shed_rate`` rule sees fleet-level shed pressure.
+- **Aggregated fleet view**: ``/status`` embeds the supervisor's
+  per-replica state (restarts, breaker, rolling-swap progress) next
+  to the frontend's own counters; ``/metrics`` exposes both in
+  Prometheus text.
+
+The frontend carries NO model state: a rolling swap or replica
+restart is invisible here beyond the routing table.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.config import ServingConfig
+from photon_ml_tpu.serving.http import (
+    READY,
+    STOPPING,
+    WARMING,
+    HttpEndpoint,
+    HttpError,
+    Readiness,
+)
+from photon_ml_tpu.telemetry import monitor as _mon
+
+logger = logging.getLogger(__name__)
+
+# Connection-level failures: the replica never produced an HTTP
+# response, so a single retry on a different replica is safe (scoring
+# is a pure read).  urllib wraps most of these in URLError; the rest
+# leak through on response-read paths.
+_RETRIABLE = (urllib.error.URLError, ConnectionError, socket.timeout,
+              TimeoutError, http.client.HTTPException)
+
+# Minimum remaining deadline budget worth spending on a retry.
+_MIN_RETRY_BUDGET_S = 0.05
+
+
+class FleetFrontend:
+    """The fleet's request-path endpoint.  Binds at construction
+    (``config.port``; 0 = ephemeral), serves after ``start()``;
+    readiness follows the fleet's ready count via
+    ``update_readiness`` (wired by the supervisor's control step)."""
+
+    def __init__(self, config: ServingConfig, supervisor,
+                 run_logger=None):
+        self.config = config
+        self.supervisor = supervisor
+        self._log = run_logger
+        self.readiness = Readiness(
+            WARMING, reason="no replica is ready yet")
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.retries = 0
+        self.failed = 0
+        self.shed = 0
+        self.t0 = time.monotonic()
+        self._http = HttpEndpoint(
+            {
+                ("POST", "/v1/score"): self._route_score,
+                ("GET", "/status"): self._route_status,
+                ("GET", "/metrics"): self._route_metrics,
+            },
+            readiness=self.readiness, port=config.port,
+            host=config.host,
+            request_timeout_s=config.http_timeout_s)
+        self.port = self._http.port
+        supervisor.attach_frontend(self)
+
+    def start(self) -> "FleetFrontend":
+        self._http.start()
+        logger.info("fleet frontend on http://%s:%d (%d replica(s))",
+                    self.config.host, self.port,
+                    self.config.replicas)
+        return self
+
+    def close(self) -> None:
+        self.readiness.set(STOPPING, reason="fleet stopping")
+        self._http.close()
+
+    def update_readiness(self, ready_count: int) -> None:
+        """Supervisor hook: ≥1 ready replica = the fleet serves."""
+        state = self.readiness.state
+        if state == STOPPING:
+            return
+        if ready_count > 0 and state != READY:
+            self.readiness.set(READY)
+        elif ready_count == 0 and state == READY:
+            self.readiness.set(
+                WARMING, reason="no replica is ready")
+
+    # -- request path --------------------------------------------------------
+
+    def _forward(self, url: str, body: bytes, timeout_s: float):
+        """One attempt against one replica → (code, payload, ctype,
+        headers) for ANY HTTP response; raises a ``_RETRIABLE`` on
+        connection-level failure."""
+        req = urllib.request.Request(
+            url + "/v1/score", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return (r.status, r.read().decode(),
+                        r.headers.get("Content-Type",
+                                      "application/json"), {})
+        except urllib.error.HTTPError as e:
+            # The replica ANSWERED: forward its verdict verbatim
+            # (incl. Retry-After on sheds) — never retried.
+            payload = e.read().decode()
+            headers = {}
+            ra = e.headers.get("Retry-After")
+            if ra is not None:
+                headers["Retry-After"] = ra
+            return (e.code, payload,
+                    e.headers.get("Content-Type", "application/json"),
+                    headers)
+
+    def _count(self, field: str, telemetry_name: str | None = None
+               ) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + 1)
+        if telemetry_name:
+            telemetry.count(telemetry_name)
+
+    def _route_score(self, body: bytes):
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + self.config.request_timeout_s
+        tried: set[int] = set()
+        attempt = 0
+        while True:
+            replica = self.supervisor.acquire_replica(exclude=tried)
+            if replica is None:
+                # Nothing to route to (all down/draining, or the one
+                # untried replica died): shed honestly.
+                self._count("shed", "serve.shed")
+                telemetry.count("serve.shed_no_replica")
+                raise HttpError(
+                    503, headers={"Retry-After": "1"},
+                    error="no ready replica"
+                          + (" (retry exhausted)" if tried else ""))
+            url = replica.url
+            tried.add(replica.idx)
+            attempt += 1
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                self.supervisor.release_replica(replica)
+                self._count("failed", "serve.frontend_failed")
+                raise HttpError(503, error="request deadline exhausted "
+                                           "before a replica answered")
+            try:
+                code, payload, ctype, headers = self._forward(
+                    url, body, budget)
+            except _RETRIABLE as e:
+                # The replica never answered: count the failure
+                # toward its wedge detection and retry EXACTLY once
+                # on a different replica inside the remaining budget.
+                self.supervisor.note_failure(replica.idx)
+                remaining = deadline - time.monotonic()
+                retriable = (attempt == 1
+                             and remaining > _MIN_RETRY_BUDGET_S)
+                logger.warning(
+                    "fleet frontend: replica %d connection failed "
+                    "(%s: %s); %s", replica.idx, type(e).__name__, e,
+                    "retrying once on another replica" if retriable
+                    else "giving up")
+                if retriable:
+                    self._count("retries", "serve.frontend_retries")
+                    self._event("fleet_retry", replica=replica.idx,
+                                error=f"{type(e).__name__}: {e}")
+                    continue
+                self._count("failed", "serve.frontend_failed")
+                raise HttpError(
+                    502, error=f"replica connection failed after "
+                               f"{attempt} attempt(s): "
+                               f"{type(e).__name__}: {e}")
+            finally:
+                self.supervisor.release_replica(replica)
+            if code == 200:
+                self._count("requests", "serve.requests")
+                telemetry.observe("serve.request_s",
+                                  time.perf_counter() - t0)
+            elif code in (429, 503):
+                # Replica-side shed (saturation/admission/deadline):
+                # fleet-level shed pressure, the serve_shed_rate
+                # rule's input.
+                self._count("shed", "serve.shed")
+                telemetry.count("serve.shed_replica")
+            with self._lock:
+                total = self.requests
+                retries, shed = self.retries, self.shed
+            _mon.progress("serve", total, unit="requests",
+                          retries=retries, shed=shed)
+            return code, payload, ctype, headers or None
+
+    def _event(self, kind: str, **fields) -> None:
+        if self._log is not None:
+            self._log.event(kind, **fields)
+
+    # -- observer routes -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "retries": self.retries,
+                "failed": self.failed,
+                "shed": self.shed,
+                "uptime_s": round(time.monotonic() - self.t0, 1),
+            }
+
+    def _route_status(self, body: bytes):
+        st = {
+            "state": self.readiness.state,
+            "frontend": self.stats(),
+            "fleet": self.supervisor.status(),
+        }
+        mon = _mon.active()
+        if mon is not None:
+            st.update(mon.status())
+        return 200, json.dumps(st), "application/json"
+
+    def _route_metrics(self, body: bytes):
+        from photon_ml_tpu.telemetry.monitor import prometheus_text
+
+        lines = [prometheus_text(_mon.active()).rstrip("\n")]
+        fleet = self.supervisor.status()
+        fe = self.stats()
+        lines.append("# TYPE photon_fleet_ready_replicas gauge")
+        lines.append(f"photon_fleet_ready_replicas {fleet['ready']}")
+        lines.append("# TYPE photon_fleet_replica_restarts_total "
+                     "counter")
+        lines.append("photon_fleet_replica_restarts_total "
+                     f"{fleet['restarts']}")
+        for name in ("requests", "retries", "failed", "shed"):
+            lines.append(f"# TYPE photon_frontend_{name}_total counter")
+            lines.append(f"photon_frontend_{name}_total {fe[name]}")
+        return 200, "\n".join(lines) + "\n", \
+            "text/plain; version=0.0.4"

@@ -20,6 +20,15 @@ The previous monitor endpoint answered an unconditional 200 the moment
 the socket bound, i.e. during exactly the plan/compile window where a
 probe answer matters; both surfaces now report honestly.
 
+Connection hardening (ISSUE 13 satellite): every accepted connection
+gets a socket timeout (``request_timeout_s``) so a stalled client —
+half-sent request line, declared-but-never-sent body — is disconnected
+instead of pinning a handler thread forever, and the request body read
+is bounded by ``max_body`` so a hostile ``Content-Length`` cannot OOM
+the process.  Route errors can carry extra response headers
+(``HttpError(..., headers={"Retry-After": "1"})`` — the overload-shed
+contract).
+
 Import discipline: stdlib only — ``telemetry.monitor`` imports this
 module, so anything heavier would cycle through the package.
 """
@@ -29,6 +38,7 @@ from __future__ import annotations
 import http.server
 import json
 import logging
+import socket
 import threading
 
 logger = logging.getLogger(__name__)
@@ -38,6 +48,11 @@ READY = "ready"
 STOPPING = "stopping"
 
 _STATES = (WARMING, READY, STOPPING)
+
+# Default per-connection socket timeout and request-body bound; both
+# overridable per endpoint (the serving config's http_timeout_s).
+DEFAULT_TIMEOUT_S = 30.0
+DEFAULT_MAX_BODY = 32 << 20
 
 
 class Readiness:
@@ -90,20 +105,31 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
     endpoint: "HttpEndpoint | None" = None
 
+    # Per-connection socket timeout (socketserver applies it in
+    # setup()): a client that stalls mid-request is disconnected
+    # instead of holding its handler thread forever.  The endpoint
+    # overrides this on the bound subclass.
+    timeout = DEFAULT_TIMEOUT_S
+
     # Request paths are small JSON (scoring rows); cap the body read so
     # a hostile Content-Length cannot balloon the handler thread.
-    MAX_BODY = 32 << 20
+    MAX_BODY = DEFAULT_MAX_BODY
 
-    def _send(self, code: int, body: str, ctype: str) -> None:
+    def _send(self, code: int, body: str, ctype: str,
+              headers: dict | None = None) -> None:
         data = body.encode()
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
 
-    def _send_json(self, code: int, obj) -> None:
-        self._send(code, json.dumps(obj), "application/json")
+    def _send_json(self, code: int, obj,
+                   headers: dict | None = None) -> None:
+        self._send(code, json.dumps(obj), "application/json",
+                   headers=headers)
 
     def _dispatch(self, method: str) -> None:
         ep = self.endpoint
@@ -132,17 +158,35 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send_json(413, {"error": "request body too large",
                                       "max_bytes": self.MAX_BODY})
                 return
-            body = self.rfile.read(length) if length else b""
+            try:
+                body = self.rfile.read(length) if length else b""
+            except (TimeoutError, socket.timeout, OSError) as e:
+                # The declared body never arrived inside the socket
+                # timeout: drop the connection — the thread must not
+                # stay pinned to a stalled client.
+                logger.warning("http: request body read failed (%r); "
+                               "closing connection", e)
+                self.close_connection = True
+                return
         try:
-            code, payload, ctype = route(body)
+            # Routes return (code, payload, ctype) or, when they need
+            # extra response headers, (code, payload, ctype, headers).
+            result = route(body)
+            if len(result) == 4:
+                code, payload, ctype, headers = result
+            else:
+                code, payload, ctype = result
+                headers = None
         except HttpError as e:
             code, payload, ctype = e.code, json.dumps(e.body), \
                 "application/json"
+            headers = e.headers
         except Exception as e:   # a handler bug must answer, not hang
             logger.exception("http route %s %s failed", method, path)
             code, payload, ctype = 500, json.dumps(
                 {"error": f"{type(e).__name__}: {e}"}), "application/json"
-        self._send(code, payload, ctype)
+            headers = None
+        self._send(code, payload, ctype, headers=headers)
 
     def do_GET(self) -> None:    # noqa: N802 (http.server API)
         self._dispatch("GET")
@@ -155,11 +199,13 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
 
 class HttpError(Exception):
-    """Raise from a route handler to answer a structured error."""
+    """Raise from a route handler to answer a structured error.
+    ``headers`` ride on the response (e.g. ``Retry-After`` on a shed)."""
 
-    def __init__(self, code: int, **body):
+    def __init__(self, code: int, headers: dict | None = None, **body):
         self.code = int(code)
         self.body = body
+        self.headers = dict(headers) if headers else None
         super().__init__(f"{code}: {body}")
 
 
@@ -172,18 +218,26 @@ class HttpEndpoint:
     ``readiness`` (see module docstring) — routes cannot shadow it.
     Handlers run on per-connection daemon threads (stdlib
     ``ThreadingHTTPServer``); blocking inside a handler (the scoring
-    path waits on its micro-batch) stalls only that connection.
+    path waits on its micro-batch) stalls only that connection, and the
+    per-connection socket timeout bounds how long a stalled CLIENT can
+    hold the thread.
 
     Binds 127.0.0.1 by default: both surfaces are operator tools, not
     public internet listeners; fronting proxies own external exposure.
     """
 
     def __init__(self, routes: dict, readiness: Readiness | None = None,
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1",
+                 request_timeout_s: float = DEFAULT_TIMEOUT_S,
+                 max_body: int = DEFAULT_MAX_BODY):
         self.routes = dict(routes)
         self.readiness = readiness if readiness is not None \
             else Readiness(READY)
-        handler = type("_BoundHandler", (_Handler,), {"endpoint": self})
+        handler = type("_BoundHandler", (_Handler,), {
+            "endpoint": self,
+            "timeout": float(request_timeout_s),
+            "MAX_BODY": int(max_body),
+        })
         self._httpd = http.server.ThreadingHTTPServer((host, port),
                                                       handler)
         self._httpd.daemon_threads = True

@@ -41,8 +41,10 @@ import numpy as np
 from photon_ml_tpu import telemetry
 from photon_ml_tpu.config import ServingConfig
 from photon_ml_tpu.serving.batcher import (
+    DeadlineExceeded,
     MicroBatcher,
     ServerClosing,
+    ServerOverloaded,
     ServerSaturated,
 )
 from photon_ml_tpu.serving.engine import BadRequest, ScoringEngine
@@ -118,7 +120,8 @@ class ModelServer:
         # hang in the accept backlog until the model is loaded.
         self._http = HttpEndpoint(self._routes(),
                                   readiness=self.readiness,
-                                  port=config.port, host=config.host)
+                                  port=config.port, host=config.host,
+                                  request_timeout_s=config.http_timeout_s)
         self._http.start()
         self.port = self._http.port
 
@@ -168,6 +171,7 @@ class ModelServer:
         return self
 
     def serve_forever(self) -> None:
+        # photon-lint: disable=eternal-wait (the main thread parks until stop() or the CLI signal handler sets the event; there is nothing to time out toward)
         self._stop_evt.wait()
 
     def stop(self) -> None:
@@ -203,6 +207,7 @@ class ModelServer:
 
     def _load_engine(self) -> ScoringEngine:
         from photon_ml_tpu.io.model_io import load_game_model
+        from photon_ml_tpu.reliability import faults
 
         cfg = self.config
         sig = _manifest_signature(cfg.model_dir)
@@ -212,6 +217,10 @@ class ModelServer:
                 f"{cfg.model_dir!r}")
         version = f"{sig[1]:x}-{sig[2]:x}"
         t0 = time.perf_counter()
+        # The swap-manifest fault seam: corrupt_file/delete_file kinds
+        # hit the real manifest on disk, so the watcher's
+        # keep-previous-good-model contract is injectable (ISSUE 13).
+        faults.fire("serve.manifest_load", path=sig[0])
         with telemetry.span("serve_model_load", cat="serve"):
             model, task = load_game_model(cfg.model_dir)
             engine = ScoringEngine(
@@ -310,18 +319,28 @@ class ModelServer:
         except BadRequest as e:
             raise HttpError(400, error=str(e))
         try:
-            margins, preds, version = self._batcher.submit(
+            margins, preds, version, degraded = self._batcher.submit(
                 parsed, timeout_s=self.config.request_timeout_s)
         except ServerSaturated as e:
-            raise HttpError(429, error=str(e))
+            raise HttpError(429, error=str(e), headers={
+                "Retry-After": f"{e.retry_after_s:.0f}"})
+        except (ServerOverloaded, DeadlineExceeded) as e:
+            # Overload sheds (admission control / queued-past-deadline)
+            # answer 503 + Retry-After: a fast, honest "not now", never
+            # a queue-collapse timeout.
+            raise HttpError(503, error=str(e), headers={
+                "Retry-After": f"{e.retry_after_s:.0f}"})
         except ServerClosing as e:
             raise HttpError(503, error=str(e))
         except TimeoutError as e:
             raise HttpError(503, error=str(e))
+        if degraded:
+            telemetry.count("serve.degraded_responses")
         out = {"margins": [float(v) for v in margins],
                "predictions": [float(v) for v in preds],
                "model_version": version,
-               "n": int(len(margins))}
+               "n": int(len(margins)),
+               **({"degraded": True} if degraded else {})}
         return 200, json.dumps(out), "application/json"
 
     def serving_status(self) -> dict:

@@ -253,7 +253,10 @@ class _RssSampler:
     def close(self) -> list:
         self._stop.set()
         if self._thread.is_alive():
-            self._thread.join()
+            # The sampler loop wakes at most _period after the stop
+            # event sets; the bounded join is belt-and-braces
+            # (photon-lint eternal-wait).
+            self._thread.join(timeout=max(5.0, self._period * 2))
         with self._lock:
             return list(self._samples)
 

@@ -72,6 +72,12 @@ METRICS: tuple[tuple[str, str, str], ...] = (
     ("serve", "serve.p99_ms", "lower"),
     ("serve", "serve.rows_per_sec", "higher"),
     ("serve", "serve.batch_fill", "higher"),
+    # Resilient fleet (ISSUE 13): the SIGKILL arm's claims — failed
+    # client requests must stay at zero (the retry-once contract) and
+    # a killed replica's detect→respawn→re-warm→ready latency must not
+    # creep.
+    ("serve", "serve.failed_requests", "lower"),
+    ("serve", "serve.restart_s", "lower"),
 )
 
 

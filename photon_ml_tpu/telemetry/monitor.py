@@ -109,6 +109,15 @@ DEFAULT_THRESHOLDS: dict = {
     # that the micro-batcher/device path is falling behind its SLO.
     "serve_p99_s": 0.5,
     "serve_min_requests": 20,
+    # serve_shed_rate (ISSUE 13): the shed fraction over the rolling
+    # window — serve.shed / (serve.shed + serve.requests), both as
+    # windowed rates — above this fraction, once at least
+    # serve_shed_min_events (sheds + served) are on record.  Shedding
+    # is the DESIGNED overload response (503 + Retry-After beats queue
+    # collapse), but a sustained shed fraction means the fleet is
+    # under-provisioned and an operator must see it.
+    "serve_shed_fraction": 0.2,
+    "serve_shed_min_events": 20,
 }
 
 _ACTIVE: "Monitor | None" = None
@@ -462,6 +471,41 @@ class Monitor:
                 p99_ms=round(p99 * 1e3, 2),
                 threshold_ms=round(th["serve_p99_s"] * 1e3, 2),
                 requests=t.counter("serve.requests"))
+        # serve_shed_rate (ISSUE 13): the 429/503 shed fraction over
+        # the rolling window.  Both legs come from the registry's
+        # windowed counter rates, so one ancient burst of sheds cannot
+        # fire the rule forever — and like every rule it latches: one
+        # overload incident, one alert.
+        shed_n = t.counter("serve.shed")
+        served_n = t.counter("serve.requests")
+        if shed_n + served_n >= th["serve_shed_min_events"]:
+            shed_rate = t.rate("serve.shed", self.window_s)
+            req_rate = t.rate("serve.requests", self.window_s)
+            total_rate = (shed_rate or 0.0) + (req_rate or 0.0)
+            if shed_rate is not None and total_rate > 0:
+                frac = shed_rate / total_rate
+                if frac > th["serve_shed_fraction"]:
+                    self._fire(
+                        "serve_shed_rate", "serve",
+                        f"{frac:.0%} of scoring requests shed "
+                        f"(429/503) over the window (threshold "
+                        f"{th['serve_shed_fraction']:.0%}); the "
+                        "serving tier is under-provisioned for the "
+                        "offered load",
+                        shed_fraction=round(frac, 3),
+                        shed=shed_n, served=served_n)
+        # replica_restarts (ISSUE 13): ANY replica restart latches —
+        # the fleet healed itself, but an operator must know a replica
+        # crashed or wedged (severity warn: the request path survived
+        # by design).
+        restarts = t.counter("fleet.replica_restarts")
+        if restarts > 0:
+            self._fire(
+                "replica_restarts", None,
+                f"{restarts} serving replica restart(s): a replica "
+                "crashed or wedged and was restarted by the "
+                "supervisor (see fleet_replica_* run-log events)",
+                restarts=restarts)
         depth = t.gauge_value("sink.queue_depth")
         with self._lock:
             if (depth is not None

@@ -767,6 +767,103 @@ def test_swallowed_exception_waiver_with_reason():
 
 
 # ---------------------------------------------------------------------------
+# eternal-wait (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_eternal_wait_flags_unbounded_waits_in_thread_classes():
+    vs = check_source(_src("""
+        import queue
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._q = queue.Queue()
+                self._done = threading.Event()
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                item = self._q.get()
+                return item
+
+            def wait_done(self):
+                self._done.wait()
+
+            def close(self):
+                self._thread.join()
+    """))
+    assert _rules(vs) == ["eternal-wait"] * 3
+    assert "blocks with no timeout" in vs[0].message
+
+
+def test_eternal_wait_clean_with_timeouts_and_outside_threads():
+    vs = check_source(_src("""
+        import queue
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._q = queue.Queue()
+                self._done = threading.Event()
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                return self._q.get(timeout=1.0)
+
+            def wait_done(self):
+                self._done.wait(5.0)
+
+            def close(self):
+                self._thread.join(timeout=10.0)
+
+            def config(self, d):
+                return d.get("key")        # dict get: has args
+
+        class NotThreaded:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            def drain(self):
+                return self._q.get()       # no thread spawned here
+    """))
+    assert vs == []
+
+
+def test_eternal_wait_flags_socket_recv():
+    vs = check_source(_src("""
+        import threading
+
+        class Net:
+            def __init__(self, sock):
+                self._sock = sock
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                return self._sock.recv(4096)
+    """))
+    assert _rules(vs) == ["eternal-wait"]
+    assert "settimeout" in vs[0].message
+
+
+def test_eternal_wait_waiver_with_reason():
+    vs = check_source(_src("""
+        import queue
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._q = queue.Queue()
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                # photon-lint: disable=eternal-wait (close() always enqueues the sentinel)
+                return self._q.get()
+    """))
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
 # the acceptance corpus + whole-repo gate + CLI contract
 # ---------------------------------------------------------------------------
 
@@ -810,6 +907,9 @@ _CORPUS = """
         def poll(self):
             return self.state
 
+        def join(self):
+            self._thread.join()
+
 
     class StreamingThing:
         def __init__(self):
@@ -840,8 +940,8 @@ def test_fixture_corpus_detects_five_distinct_rules():
     distinct = set(_rules(vs))
     assert {"jit-in-function", "tracer-hygiene", "unlocked-shared-write",
             "accumulator-dtype", "env-read", "naked-clock",
-            "swallowed-exception"} <= distinct
-    assert len(distinct) >= 7
+            "swallowed-exception", "eternal-wait"} <= distinct
+    assert len(distinct) >= 8
 
 
 def test_repo_clean():

@@ -17,14 +17,14 @@ _BENCH = os.path.join(os.path.dirname(os.path.dirname(
 _TINY = ["--n", "4096", "--d", "2048", "--k", "4"]
 
 
-def _run_bench(tmp_path, *args):
+def _run_bench(tmp_path, *args, timeout=300):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, _BENCH, "--cache-dir", str(tmp_path / "cache"),
          *args],
-        capture_output=True, text=True, timeout=300, env=env,
+        capture_output=True, text=True, timeout=timeout, env=env,
     )
     return proc
 
@@ -332,6 +332,21 @@ def test_history_spec_watches_serve():
     assert directions["serve:serve.batch_fill"] == "higher"
 
 
+@pytest.mark.fast
+def test_history_spec_watches_serve_fleet():
+    """ISSUE 13 satellite: the history spec gates the fleet arm's
+    claims — failed client requests (the retry-once contract says 0)
+    and the killed replica's detect→ready restart latency."""
+    from photon_ml_tpu.telemetry.history import METRICS
+
+    keys = {(s, p) for s, p, _ in METRICS}
+    assert ("serve", "serve.failed_requests") in keys
+    assert ("serve", "serve.restart_s") in keys
+    directions = {f"{s}:{p}": d for s, p, d in METRICS}
+    assert directions["serve:serve.failed_requests"] == "lower"
+    assert directions["serve:serve.restart_s"] == "lower"
+
+
 @pytest.mark.slow   # server subprocess + client storm
 def test_bench_serve_section_contract(tmp_path):
     """`--section serve` keeps the budget/JSON-last-line contract and
@@ -340,7 +355,7 @@ def test_bench_serve_section_contract(tmp_path):
     margin parity vs the batch scorer, the server's own peak RSS, and
     the server subprocess's clean rc."""
     proc = _run_bench(tmp_path, "--section", "serve",
-                      "--budget-s", "240", *_TINY)
+                      "--budget-s", "420", *_TINY, timeout=560)
     assert proc.returncode == 0, proc.stderr[-3000:]
     rec = json.loads(
         [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
@@ -358,6 +373,19 @@ def test_bench_serve_section_contract(tmp_path):
     assert s["server_peak_rss_mb"] > 0
     assert s["server_rc"] == 0
     assert rec["peak_rss_mb"]["serve"] > 0
+    # Fleet arm (ISSUE 13): 2 replicas, one SIGKILLed mid-storm —
+    # zero failed client requests, the restart latency measured, the
+    # shed fraction reported, and a clean frontend exit.
+    if "skipped" in s.get("fleet", {}):
+        pytest.fail(f"fleet arm skipped: {s['fleet']['skipped']}")
+    assert s["failed_requests"] == 0
+    assert s["restart_s"] is not None and s["restart_s"] > 0
+    assert 0.0 <= s["shed_fraction"] < 1.0
+    f = s["fleet"]
+    assert f["replicas"] == 2
+    assert f["requests"] > 0
+    assert f["restarts"] >= 1
+    assert f["frontend_rc"] == 0
 
 
 def test_bench_history_dir_appends_envelope(tmp_path):

@@ -450,6 +450,94 @@ def test_serve_tail_latency_negative_paths():
     m2.close()
 
 
+def test_serve_shed_rate_fires_on_sustained_shedding_latched():
+    """ISSUE 13 satellite (positive): a shed fraction above the
+    threshold over the rolling window fires serve_shed_rate exactly
+    once, stamped with the observed fraction."""
+    clock = _FakeClock()
+    reg = _registry(clock)
+    m, sink, _ = _monitor(clock=clock, session=reg)
+    for _ in range(10):                 # 50% shed, well over 20%
+        clock.tick(0.1)
+        reg.count("serve.requests")
+        reg.count("serve.shed")
+    clock.tick(0.1)
+    m.progress("serve", 10, unit="requests")
+    assert _rules(sink) == ["serve_shed_rate"]
+    alert = sink.of("alert")[0]
+    assert alert["stage"] == "serve"
+    assert alert["shed_fraction"] == pytest.approx(0.5, abs=0.05)
+    # Latched: continued shedding re-fires nothing.
+    clock.tick(0.5)
+    reg.count("serve.shed")
+    m.progress("serve", 11, unit="requests")
+    assert _rules(sink) == ["serve_shed_rate"]
+    m.close()
+
+
+def test_serve_shed_rate_negative_paths():
+    """ISSUE 13 satellite (negative): a small shed fraction never
+    fires, and heavy shedding below the minimum event count is
+    start-up noise."""
+    clock = _FakeClock()
+    reg = _registry(clock)
+    m, sink, _ = _monitor(clock=clock, session=reg)
+    for i in range(40):                 # 2.5% shed, under 20%
+        clock.tick(0.1)
+        reg.count("serve.requests")
+        if i == 0:
+            reg.count("serve.shed")
+    clock.tick(0.1)
+    m.progress("serve", 40, unit="requests")
+    assert _rules(sink) == []
+    m.close()
+
+    clock2 = _FakeClock()
+    reg2 = _registry(clock2)
+    m2, sink2, _ = _monitor(clock=clock2, session=reg2)
+    for _ in range(5):                  # 100% shed but too few events
+        clock2.tick(0.1)
+        reg2.count("serve.shed")
+    clock2.tick(0.1)
+    m2.progress("serve", 0, unit="requests")
+    assert _rules(sink2) == []
+    m2.close()
+
+
+def test_replica_restarts_any_restart_latches():
+    """ISSUE 13 satellite (positive): ANY replica restart fires the
+    rule once — and only once, however many more restarts follow."""
+    clock = _FakeClock()
+    reg = _registry(clock)
+    m, sink, _ = _monitor(clock=clock, session=reg)
+    reg.count("fleet.replica_restarts")
+    clock.tick(0.5)
+    m.progress("serve", 1, unit="requests")
+    assert _rules(sink) == ["replica_restarts"]
+    assert sink.of("alert")[0]["restarts"] == 1
+    reg.count("fleet.replica_restarts", 3)
+    clock.tick(0.5)
+    m.progress("serve", 2, unit="requests")
+    assert _rules(sink) == ["replica_restarts"]      # latched
+    m.close()
+
+
+def test_replica_restarts_negative_without_restarts():
+    """ISSUE 13 satellite (negative): recycles (deploy bounces) and
+    ordinary traffic never fire replica_restarts."""
+    clock = _FakeClock()
+    reg = _registry(clock)
+    m, sink, _ = _monitor(clock=clock, session=reg)
+    reg.count("fleet.replica_recycles", 2)   # rolling swap, not crash
+    for _ in range(30):
+        clock.tick(0.1)
+        reg.count("serve.requests")
+        reg.observe("serve.request_s", 0.005)
+    m.progress("serve", 30, unit="requests")
+    assert _rules(sink) == []
+    m.close()
+
+
 def test_alerts_disabled_evaluates_nothing():
     m, sink, clock = _monitor(every_s=0.0, alerts=False)
     for i in range(5):
