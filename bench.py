@@ -150,6 +150,17 @@ SERVE_WARM_REQS = 8              # per client, before the clock starts
 SERVE_INTERVAL_S = 0.010         # open-loop firing cadence per client
 SERVE_POOL = 512                 # distinct request rows replayed
 SERVE_BATCH_ROWS = 64            # largest micro-batch bucket
+# Request tracing (ISSUE 14): the ON arm's tail threshold — low enough
+# that the storm's queueing tail samples richly, high enough that the
+# steady-state p50 request is dropped after its histogram folds.
+SERVE_TRACE_THRESHOLD_MS = 25.0
+# Closed-loop PAIRS for the tracing-overhead A/B: ONE request in
+# flight alternating between the live off/on servers, so p50 is the
+# request SERVICE time and each pair shares one instant of box state.
+# The open-loop storm offers more load than a 2-core box sustains —
+# its p50 is queue depth, which amplifies any delta and measures
+# nothing about tracing.
+SERVE_CLOSED_REQS = 600
 
 # Fleet arm (ISSUE 13): supervisor + 2 replicas behind the frontend,
 # one replica SIGKILLed mid-storm.  Claims under test: zero failed
@@ -191,12 +202,13 @@ SECTION_EST_S = {
     # Two subprocess arms × (chunk ETL + a warm-up fit + the measured
     # fit: CDF_FUSED_CYCLES+1 passes fused, ~C×iters passes legacy).
     "cd_fused": 480.0,
-    # One server subprocess (model load + bucket warm-up) + the
-    # open-loop client storm (~CLIENTS × REQS × INTERVAL of wall) +
-    # the parent's parity pass over the request pool, then the fleet
-    # arm: 2 replica warm-ups, a ~6 s storm with a mid-run SIGKILL,
-    # and the restart-latency wait.
-    "serve": 420.0,
+    # TWO server subprocess arms (tracing off/on A/B — model load +
+    # bucket warm-up each) + the open-loop client storm per arm
+    # (~CLIENTS × REQS × INTERVAL of wall) + the parent's parity pass
+    # over the request pool, then the fleet arm: 2 replica warm-ups, a
+    # ~6 s storm with a mid-run SIGKILL, the restart-latency wait, and
+    # the serve-report cross-process trace join.
+    "serve": 480.0,
 }
 
 
@@ -1782,18 +1794,201 @@ def section_cd_fused(ctx: BenchContext) -> None:
           file=sys.stderr)
 
 
-def section_serve(ctx: BenchContext) -> None:
-    """Online serving (ISSUE 12 tentpole measurement): a subprocess-
-    isolated model server under SERVE_CLIENTS concurrent open-loop
-    clients.  Claims under test: served margins match the batch scorer
-    on the identical rows, client-observed p50/p99 latency and
-    sustained rows/s under concurrency, micro-batch fill, and the
-    server's own peak RSS — all from the real socket path."""
-    import shutil
-    import signal
-    import subprocess
+class _ServeServer:
+    """One subprocess-isolated model server for the serve section:
+    spawn with a config dict, poll ready, post, stop.  Two of these
+    run SIMULTANEOUSLY for the tracing A/B (ISSUE 14) so alternating
+    probe requests hit both arms under the identical instantaneous box
+    state — sequential arms on a shared 2-core box measured ±15%
+    drift, an order of magnitude above the effect."""
+
+    def __init__(self, ctx: BenchContext, cfg: dict, arm: str):
+        import subprocess
+
+        self.arm = arm
+        self.cfg_path = os.path.join(ctx.cache_dir,
+                                     f"serve_config_{arm}.json")
+        self._info_path = os.path.join(ctx.cache_dir,
+                                       f"serve_info_{arm}.json")
+        if os.path.exists(self._info_path):
+            os.remove(self._info_path)
+        with open(self.cfg_path, "w") as f:
+            json.dump(cfg, f)
+        self.t_start = time.time()
+        self.url: str | None = None
+        self.warm_wait_s: float | None = None
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "photon_ml_tpu.serving",
+             "--config", self.cfg_path, "--info-file", self._info_path],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+
+    def _startup_fail(self, msg: str):
+        # Kill BEFORE reading stderr: read() on a live child's pipe
+        # blocks until an EOF that never comes (the startup-timeout
+        # branch reaches here with the server still running).
+        if self.proc.poll() is None:
+            self.proc.kill()
+        _out, err = self.proc.communicate()
+        return RuntimeError(
+            f"serve[{self.arm}]: {msg}: {(err or '')[-500:]}")
+
+    def wait_ready(self, deadline: float) -> None:
+        import urllib.request
+
+        while not os.path.exists(self._info_path):
+            if self.proc.poll() is not None or time.time() > deadline:
+                raise self._startup_fail(
+                    "server never wrote its info file")
+            time.sleep(0.05)
+        with open(self._info_path) as f:
+            self.url = json.load(f)["url"]
+        while True:          # poll /healthz: warming → ready
+            if self.proc.poll() is not None or time.time() > deadline:
+                raise self._startup_fail("server never became ready")
+            try:
+                with urllib.request.urlopen(self.url + "/healthz",
+                                            timeout=2) as r:
+                    if json.loads(r.read())["state"] == "ready":
+                        break
+            except OSError:
+                pass
+            time.sleep(0.1)
+        self.warm_wait_s = time.time() - self.t_start
+
+    def post(self, body: bytes) -> dict:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url + "/v1/score", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    def status(self) -> dict:
+        import urllib.request
+
+        with urllib.request.urlopen(self.url + "/status",
+                                    timeout=10) as r:
+            return json.loads(r.read())["serving"]
+
+    def stop(self) -> dict | None:
+        """SIGTERM, drain, return the CLI's final JSON line (or None
+        if the exit was unclean — the caller raises)."""
+        import signal
+        import subprocess
+
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            stdout, stderr = self.proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            stdout, stderr = self.proc.communicate()
+        sys.stderr.write(stderr[-2000:] if stderr else "")
+        if self.proc.returncode != 0:
+            raise RuntimeError(f"serve[{self.arm}]: server exited rc="
+                               f"{self.proc.returncode}")
+        return json.loads(
+            [ln for ln in stdout.splitlines() if ln.strip()][-1])
+
+
+def _serve_storm(srv: _ServeServer, bodies: list) -> tuple:
+    """The open-loop client storm against one server: SERVE_CLIENTS
+    threads each firing on a fixed schedule (queue delay lands IN the
+    measured latency) — a warm storm first, then the measured one.
+    → (sorted latencies, measured wall seconds)."""
     import threading
-    import urllib.request
+
+    latencies: list[list[float]] = [[] for _ in range(SERVE_CLIENTS)]
+    errors: list = []
+
+    def client(c: int, measured: bool) -> None:
+        reqs_n = (SERVE_REQS_PER_CLIENT if measured
+                  else SERVE_WARM_REQS)
+        t0 = time.perf_counter()
+        for j in range(reqs_n):
+            target = t0 + j * SERVE_INTERVAL_S
+            lag = target - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+            body = bodies[(c * 31 + j) % len(bodies)]
+            t1 = time.perf_counter()
+            try:
+                srv.post(body)
+            except Exception as e:  # noqa: BLE001 - recorded
+                errors.append(f"{type(e).__name__}: {e}")
+                continue
+            if measured:
+                latencies[c].append(time.perf_counter() - t1)
+
+    for measured in (False, True):     # warm storm, then the clock
+        t0 = time.time()
+        threads = [threading.Thread(target=client, args=(c, measured))
+                   for c in range(SERVE_CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_s = time.time() - t0
+    lat = np.asarray(sorted(x for c in latencies for x in c))
+    if errors or not len(lat):
+        raise RuntimeError(f"serve: {len(errors)} client error(s): "
+                           f"{errors[:3]}")
+    return lat, wall_s
+
+
+def _serve_paired_closed_loop(off: _ServeServer, on: _ServeServer,
+                              bodies: list) -> dict:
+    """The tracing-overhead A/B (ISSUE 14): one request in flight,
+    ALTERNATING between the live off/on servers — each pair runs under
+    the same instantaneous box state, so the median pairwise delta is
+    the tracing cost, not queue depth (open-loop storms here run past
+    a 2-core box's capacity) and not inter-arm drift (sequential arms
+    measured ±15% on the shared build box)."""
+    off_lat, on_lat, deltas = [], [], []
+    for j in range(SERVE_CLOSED_REQS):
+        body = bodies[j % len(bodies)]
+        # Alternate which arm goes first inside the pair so per-pair
+        # cache/scheduler asymmetry cancels too.
+        order = (off, on) if j % 2 == 0 else (on, off)
+        pair = {}
+        for srv in order:
+            t1 = time.perf_counter()
+            srv.post(body)
+            pair[srv.arm] = time.perf_counter() - t1
+        off_lat.append(pair["off"])
+        on_lat.append(pair["on"])
+        deltas.append(pair["on"] - pair["off"])
+    p50_off = float(np.percentile(off_lat, 50)) * 1e3
+    p50_on = float(np.percentile(on_lat, 50)) * 1e3
+    delta_ms = float(np.percentile(deltas, 50)) * 1e3
+    return {
+        "p50_off_ms": round(p50_off, 3),
+        "p50_on_ms": round(p50_on, 3),
+        # The claim of record: the MEDIAN PAIRWISE delta over the off
+        # p50 — each pair shares one instant of box state, so marginal
+        # p50 jitter (±4% observed on the build box) cancels and the
+        # per-request tracing cost survives.
+        "overhead_frac": (round(delta_ms / p50_off, 4)
+                          if p50_off > 0 else None),
+        "median_pair_delta_ms": round(delta_ms, 4),
+        "closed_reqs": SERVE_CLOSED_REQS,
+    }
+
+
+def section_serve(ctx: BenchContext) -> None:
+    """Online serving (ISSUE 12 tentpole measurement + ISSUE 14
+    tracing A/B): TWO simultaneous subprocess-isolated model servers —
+    tracing off and tracing on — with the open-loop client storm on
+    the ON arm (the production-shape numbers) and an alternating
+    one-in-flight closed loop across BOTH arms measuring the tracing
+    overhead against its ≤2% budget under identical box state.
+    Claims under test: served margins match the batch scorer on the
+    identical rows, client-observed p50/p99 latency and sustained
+    rows/s under concurrency, micro-batch fill, the tracing stage
+    medians (queue-wait / dispatch), and the server's own peak RSS —
+    all from the real socket path."""
+    import shutil
 
     from photon_ml_tpu.estimators.streaming_scorer import (
         StreamingGameScorer,
@@ -1821,131 +2016,77 @@ def section_serve(ctx: BenchContext) -> None:
               for lo in range(0, pool_n - SERVE_ROWS_PER_REQ + 1,
                               SERVE_ROWS_PER_REQ)]
 
-    cfg_path = os.path.join(ctx.cache_dir, "serve_config.json")
-    info_path = os.path.join(ctx.cache_dir, "serve_info.json")
-    for p in (info_path,):
-        if os.path.exists(p):
-            os.remove(p)
-    with open(cfg_path, "w") as f:
-        json.dump({
-            "model_dir": model_dir,
-            "batch_rows": SERVE_BATCH_ROWS,
-            "batch_deadline_ms": 2.0,
-            "ell_row_capacity": max(k, 8),
-            "spill_dir": os.path.join(ctx.cache_dir, "spill_serve"),
-            "hot_swap_poll_s": 0.0,
-            "compilation_cache_dir": (None if ctx.no_compile_cache
-                                      else ctx.cache_dir),
-        }, f)
-    t_start = time.time()
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "photon_ml_tpu.serving",
-         "--config", cfg_path, "--info-file", info_path],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        cwd=os.path.dirname(os.path.abspath(__file__)))
-    def _startup_fail(msg: str):
-        # Kill BEFORE reading stderr: read() on a live child's pipe
-        # blocks until an EOF that never comes (the startup-timeout
-        # branch reaches here with the server still running).
-        if proc.poll() is None:
-            proc.kill()
-        _out, err = proc.communicate()
-        return RuntimeError(f"serve: {msg}: {(err or '')[-500:]}")
-
+    base_cfg = {
+        "model_dir": model_dir,
+        "batch_rows": SERVE_BATCH_ROWS,
+        "batch_deadline_ms": 2.0,
+        "ell_row_capacity": max(k, 8),
+        "spill_dir": os.path.join(ctx.cache_dir, "spill_serve"),
+        "hot_swap_poll_s": 0.0,
+        "compilation_cache_dir": (None if ctx.no_compile_cache
+                                  else ctx.cache_dir),
+    }
+    servers: dict = {}
     try:
+        on_cfg = dict(base_cfg, trace="on",
+                      trace_threshold_ms=SERVE_TRACE_THRESHOLD_MS,
+                      log_path=os.path.join(ctx.cache_dir,
+                                            "serve_on_log.jsonl"))
+        servers["on"] = on = _ServeServer(ctx, on_cfg, "on")
+        servers["off"] = off = _ServeServer(
+            ctx, dict(base_cfg, trace="off"), "off")
         deadline = time.time() + max(60.0, ctx.remaining())
-        while not os.path.exists(info_path):
-            if proc.poll() is not None or time.time() > deadline:
-                raise _startup_fail("server never wrote its info file")
-            time.sleep(0.05)
-        with open(info_path) as f:
-            url = json.load(f)["url"]
-        while True:          # poll /healthz: warming → ready
-            if proc.poll() is not None or time.time() > deadline:
-                raise _startup_fail("server never became ready")
+        for srv in (on, off):
+            srv.wait_ready(deadline)
+
+        # Paired A/B FIRST, both servers equally fresh (an arm that
+        # just absorbed the storm measures slower for non-tracing
+        # reasons — heap/allocator history — and poisons the delta).
+        overhead = _serve_paired_closed_loop(off, on, bodies)
+        final = {"off": off.stop()}
+        del servers["off"]
+
+        # The open-loop storm runs on the ON arm ALONE (tracing is the
+        # new default — these are the production-shape numbers of
+        # record, comparable to prior rounds; the OFF arm is gone so
+        # its residency cannot perturb them).
+        lat, wall_s = _serve_storm(on, bodies)
+        parity_out = on.post(bodies[0])
+        status = on.status()
+        final["on"] = on.stop()
+        del servers["on"]
+    except BaseException:
+        # Kill AND reap any still-live server, surfacing its stderr —
+        # the root cause of a serve-section failure usually lives
+        # there, and an unreaped child leaks a zombie + pipe FDs for
+        # the rest of the bench run.
+        for srv in servers.values():
+            if srv.proc.poll() is None:
+                srv.proc.kill()
             try:
-                with urllib.request.urlopen(url + "/healthz",
-                                            timeout=2) as r:
-                    if json.loads(r.read())["state"] == "ready":
-                        break
-            except OSError:
+                _out, err = srv.proc.communicate(timeout=10)
+                sys.stderr.write((err or "")[-2000:])
+            except Exception:  # photon-lint: disable=swallowed-exception (best-effort teardown forensics: the original section failure is already propagating and must not be masked by a reap error)
                 pass
-            time.sleep(0.1)
-        warm_wait_s = time.time() - t_start
+        raise
+    rows_total = len(lat) * SERVE_ROWS_PER_REQ
 
-        def post(body: bytes) -> dict:
-            req = urllib.request.Request(
-                url + "/v1/score", data=body,
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=60) as r:
-                return json.loads(r.read())
+    # Parity: one ON-arm response vs the batch path's margins on the
+    # identical rows.
+    ref = StreamingGameScorer(
+        model=model, task=task, chunk_rows=pool_n).score(
+        sub, keep_margins=True)
+    parity = float(np.max(np.abs(
+        np.asarray(parity_out["margins"], np.float32)
+        - ref["margins"][:SERVE_ROWS_PER_REQ])))
 
-        latencies: list[list[float]] = [[] for _ in
-                                        range(SERVE_CLIENTS)]
-        errors: list = []
+    stages = status.get("stages") or {}
+    overhead["sampled"] = (
+        (status.get("tracing") or {}).get("sampled_tail", 0)
+        + (status.get("tracing") or {}).get("sampled_floor", 0))
 
-        def client(c: int, measured: bool) -> None:
-            reqs_n = (SERVE_REQS_PER_CLIENT if measured
-                      else SERVE_WARM_REQS)
-            t0 = time.perf_counter()
-            for j in range(reqs_n):
-                # Open loop: fire on the schedule, late or not — queue
-                # delay lands in the measured latency.
-                target = t0 + j * SERVE_INTERVAL_S
-                lag = target - time.perf_counter()
-                if lag > 0:
-                    time.sleep(lag)
-                body = bodies[(c * 31 + j) % len(bodies)]
-                t1 = time.perf_counter()
-                try:
-                    post(body)
-                except Exception as e:  # noqa: BLE001 - recorded
-                    errors.append(f"{type(e).__name__}: {e}")
-                    continue
-                if measured:
-                    latencies[c].append(time.perf_counter() - t1)
-
-        for measured in (False, True):     # warm storm, then the clock
-            t0 = time.time()
-            threads = [threading.Thread(target=client,
-                                        args=(c, measured))
-                       for c in range(SERVE_CLIENTS)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            wall_s = time.time() - t0
-        lat = np.asarray(sorted(x for c in latencies for x in c))
-        if errors or not len(lat):
-            raise RuntimeError(f"serve: {len(errors)} client "
-                               f"error(s): {errors[:3]}")
-        rows_total = len(lat) * SERVE_ROWS_PER_REQ
-
-        # Parity: one measured request pool scored by the batch path.
-        ref = StreamingGameScorer(
-            model=model, task=task, chunk_rows=pool_n).score(
-            sub, keep_margins=True)
-        out = post(json.dumps({"rows": reqs[:SERVE_ROWS_PER_REQ]})
-                   .encode())
-        parity = float(np.max(np.abs(
-            np.asarray(out["margins"], np.float32)
-            - ref["margins"][:SERVE_ROWS_PER_REQ])))
-
-        with urllib.request.urlopen(url + "/status", timeout=10) as r:
-            status = json.loads(r.read())["serving"]
-    finally:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            stdout, stderr = proc.communicate(timeout=60)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            stdout, stderr = proc.communicate()
-        sys.stderr.write(stderr[-2000:] if stderr else "")
-    if proc.returncode != 0:
-        raise RuntimeError(f"serve: server exited rc="
-                           f"{proc.returncode}")
-    final = json.loads(
-        [ln for ln in stdout.splitlines() if ln.strip()][-1])
+    def _stage_p50(name: str):
+        return (stages.get(name) or {}).get("p50_ms")
 
     ctx.record["serve"] = {
         "clients": SERVE_CLIENTS,
@@ -1953,7 +2094,7 @@ def section_serve(ctx: BenchContext) -> None:
         "requests": int(len(lat)),
         "interval_ms": SERVE_INTERVAL_S * 1e3,
         "batch_rows": SERVE_BATCH_ROWS,
-        "warm_wait_s": round(warm_wait_s, 2),
+        "warm_wait_s": round(on.warm_wait_s, 2),
         "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
         "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
         "rows_per_sec": round(rows_total / wall_s, 1),
@@ -1962,7 +2103,12 @@ def section_serve(ctx: BenchContext) -> None:
         "batches": status["batcher"]["batches"],
         "margin_parity_max": parity,
         "server_peak_rss_mb": status["peak_rss_mb"],
-        "server_rc": final["rc"],
+        "server_rc": final["on"]["rc"],
+        # ISSUE 14: history-gated stage medians + the paired tracing
+        # overhead A/B (alternating closed loop across both live arms).
+        "queue_wait_ms": _stage_p50("queue_wait"),
+        "dispatch_ms": _stage_p50("dispatch"),
+        "trace_overhead": overhead,
     }
     s = ctx.record["serve"]
     print(f"serve: {SERVE_CLIENTS} clients x "
@@ -1970,8 +2116,13 @@ def section_serve(ctx: BenchContext) -> None:
           f"p50 {s['p50_ms']} ms, p99 {s['p99_ms']} ms, "
           f"{s['rows_per_sec']} rows/s, batch fill {s['batch_fill']}, "
           f"parity {parity:.2e}, server peak RSS "
-          f"{s['server_peak_rss_mb']} MB", file=sys.stderr)
-    _serve_fleet_arm(ctx, cfg_path, bodies)
+          f"{s['server_peak_rss_mb']} MB; stage medians queue_wait "
+          f"{s['queue_wait_ms']} ms / dispatch {s['dispatch_ms']} ms; "
+          f"tracing overhead p50 {overhead['p50_off_ms']} → "
+          f"{overhead['p50_on_ms']} ms ({overhead['overhead_frac']}, "
+          f"median pair delta {overhead['median_pair_delta_ms']} ms)",
+          file=sys.stderr)
+    _serve_fleet_arm(ctx, on.cfg_path, bodies)
 
 
 def _serve_fleet_arm(ctx: BenchContext, base_cfg_path: str,
@@ -1998,6 +2149,7 @@ def _serve_fleet_arm(ctx: BenchContext, base_cfg_path: str,
 
     with open(base_cfg_path) as f:
         cfg = json.load(f)
+    frontend_log = os.path.join(ctx.cache_dir, "fleet_frontend.jsonl")
     cfg.update({
         "replicas": SERVE_FLEET_REPLICAS,
         # Tight detection/restart knobs: the measured restart latency
@@ -2006,6 +2158,12 @@ def _serve_fleet_arm(ctx: BenchContext, base_cfg_path: str,
         "probe_every_s": 0.25,
         "probe_timeout_s": 2.0,
         "restart_backoff_s": 0.25,
+        # Request tracing across the fleet (ISSUE 14): the frontend
+        # writes its trace log here; replicas write theirs under the
+        # fleet workdir — serve-report joins them by trace id below.
+        "trace": "on",
+        "trace_threshold_ms": SERVE_TRACE_THRESHOLD_MS,
+        "log_path": frontend_log,
     })
     fleet_cfg_path = os.path.join(ctx.cache_dir, "serve_fleet.json")
     with open(fleet_cfg_path, "w") as f:
@@ -2144,11 +2302,51 @@ def _serve_fleet_arm(ctx: BenchContext, base_cfg_path: str,
     final = json.loads(
         [ln for ln in stdout.splitlines() if ln.strip()][-1])
 
+    # Cross-process trace join (ISSUE 14 acceptance): serve-report
+    # over the frontend's and every replica's trace logs — the SIGKILL
+    # storm guarantees retried requests, so the retry-cost column is
+    # exercised, and ≥99% of replica-side tail requests must join a
+    # frontend trace by trace id.
+    trace_join = None
+    try:
+        import glob as _glob
+        import io as _io
+
+        from photon_ml_tpu.telemetry.serve_report import (
+            run_serve_report,
+        )
+
+        replica_logs = sorted(_glob.glob(
+            os.path.join(fleet_dir, "replica_*.jsonl")))
+        if os.path.exists(frontend_log) and replica_logs:
+            buf = _io.StringIO()
+            rep = run_serve_report([frontend_log] + replica_logs,
+                                   out=buf)
+            trace_join = {
+                "ok": rep["ok"],
+                "join_fraction": rep["join_fraction"],
+                "tail_requests": rep["tail_requests"],
+                "retried_requests": rep["retried_requests"],
+                "retry_cost_ms": rep["retry_cost_ms"]["total"],
+                "dominant_stage": rep["dominant_stage"],
+            }
+            print(f"serve fleet trace join: "
+                  f"{rep['joined']}/{rep['tail_requests']} tail "
+                  f"requests joined "
+                  f"({rep['join_fraction']}), dominant stage "
+                  f"{rep['dominant_stage']}, "
+                  f"{rep['retried_requests']} retried",
+                  file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - recorded, never fatal
+        trace_join = {"error": f"{type(e).__name__}: {e}"}
+        print(f"serve fleet trace join FAILED: {e}", file=sys.stderr)
+
     s = ctx.record["serve"]
     # History-gated claims ride at the serve.* top level.
     s["failed_requests"] = len(errors)
     s["restart_s"] = st["fleet"]["last_restart_s"]
     s["shed_fraction"] = round(shed_fraction, 4)
+    s["trace_join"] = trace_join
     s["fleet"] = {
         "replicas": SERVE_FLEET_REPLICAS,
         "requests": int(len(lat)),

@@ -663,6 +663,21 @@ class ServingConfig:
     # replicas): a stalled client is disconnected instead of pinning a
     # handler thread forever.
     http_timeout_s: float = 30.0
+    # --- Request tracing (ISSUE 14) ------------------------------------
+    # End-to-end request tracing: per-request stage timestamps (+ the
+    # shared micro-batch span), trace-id propagation across the fleet,
+    # and tail-based sampling into a bounded ring buffer + request_trace
+    # JSONL events.  "on" costs ≤2% on p50 (guard-pinned A/B, PERF.md
+    # round 19); "off" is the pre-tracing request path bit for bit.
+    trace: str = "on"
+    # Tail threshold: a request slower than this is retained (sampled
+    # as "tail"); every trace_sample_every-th request is retained
+    # regardless (the deterministic floor; 0 disables the floor).
+    trace_threshold_ms: float = 50.0
+    trace_sample_every: int = 100
+    # Retained traces kept in process memory (the /status view); every
+    # retained trace is also a request_trace event on the run log.
+    trace_buffer: int = 512
 
     def validate(self) -> None:
         if not self.model_dir:
@@ -722,6 +737,15 @@ class ServingConfig:
             raise ValueError("replica_ready_timeout_s must be positive")
         if self.http_timeout_s <= 0:
             raise ValueError("http_timeout_s must be positive")
+        if self.trace not in ("on", "off"):
+            raise ValueError("trace must be on|off")
+        if self.trace_threshold_ms < 0:
+            raise ValueError("trace_threshold_ms must be >= 0")
+        if self.trace_sample_every < 0:
+            raise ValueError(
+                "trace_sample_every must be >= 0 (0 = no floor)")
+        if self.trace_buffer < 1:
+            raise ValueError("trace_buffer must be >= 1")
 
     def buckets(self) -> list[int]:
         """The closed micro-batch shape set, smallest first."""

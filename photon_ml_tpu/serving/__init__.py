@@ -25,6 +25,13 @@ process that keeps the fused scoring program warm and answers
   (least-outstanding routing, bounded retry-once, overload shedding,
   aggregated fleet ``/status``); ``replicas > 1`` in the config runs
   it from the same CLI.
+- ``serving.tracing`` (ISSUE 14): end-to-end request tracing — trace
+  ids propagated frontend → replica and echoed on every response
+  (``X-Photon-Request-Id``), per-request stage durations + the shared
+  micro-batch span, tail-sampled into a bounded ring and
+  ``request_trace`` JSONL events; ``python -m photon_ml_tpu.telemetry
+  serve-report`` joins the processes' logs into the cross-process
+  latency decomposition.
 """
 
 # NOTE: no eager submodule imports — ``telemetry.monitor`` imports the

@@ -55,6 +55,7 @@ import time
 import numpy as np
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.serving import tracing
 from photon_ml_tpu.telemetry import monitor as _mon
 
 logger = logging.getLogger(__name__)
@@ -101,7 +102,8 @@ class _Slot:
     """One request's result hand-off (condition-guarded)."""
 
     __slots__ = ("rows", "n", "deadline", "_cv", "_done", "result",
-                 "error", "version", "degraded")
+                 "error", "version", "degraded", "t_enq", "queue_wait",
+                 "batch")
 
     def __init__(self, rows, n: int, deadline: float = math.inf):
         self.rows = rows
@@ -113,6 +115,9 @@ class _Slot:
         self.error: BaseException | None = None
         self.version: str | None = None
         self.degraded = False
+        self.t_enq = time.perf_counter()   # tracing: queue-wait basis
+        self.queue_wait: float | None = None
+        self.batch: str | None = None      # linked BatchTrace id
 
     def finish(self, result=None, error=None, version=None,
                degraded: bool = False) -> None:
@@ -194,10 +199,16 @@ class MicroBatcher:
         telemetry.count("serve.shed")
         telemetry.count(f"serve.shed_{cause}")
 
-    def submit(self, parsed_rows: list, timeout_s: float = 30.0):
+    def submit(self, parsed_rows: list, timeout_s: float = 30.0,
+               trace=None, t_admit: float | None = None):
         """Block until scored: → (margins [n], preds [n], version,
         degraded).  Called from HTTP handler threads; oversized
-        requests split across ≤max_rows slots and reassemble here."""
+        requests split across ≤max_rows slots and reassemble here.
+
+        ``trace`` (ISSUE 14): the request's ``RequestTrace`` —
+        admission (from ``t_admit``, the route's entry clock, so the
+        parse is included) and queue-wait stamp onto it, and the
+        dispatched batch's id links it to the shared batch trace."""
         t0 = time.perf_counter()
         deadline = self._clock() + timeout_s
         slots = []
@@ -241,7 +252,14 @@ class MicroBatcher:
                     self._q.put(slot)
                     self._queued_rows += len(piece)
                     slots.append(slot)
+        if trace is not None:
+            # Admission = route entry (parse included) → enqueued (or
+            # shed): the client-visible pre-queue stage.
+            trace.stamp("admission", time.perf_counter()
+                        - (t_admit if t_admit is not None else t0))
         if shed_exc is not None:
+            if trace is not None:
+                trace.shed = shed_cause
             telemetry.count("serve.shed")
             telemetry.count(f"serve.shed_{shed_cause}")
             raise shed_exc
@@ -253,6 +271,23 @@ class MicroBatcher:
             degraded = degraded or deg
             margins.append(m)
             preds.append(p)
+        if trace is not None:
+            # Queue wait is PER REQUEST (a split request's slowest
+            # slot); the shared batch stages live on the linked batch
+            # trace — the per-request vs shared-compute attribution.
+            # An oversize request spans several batches: link the one
+            # the request actually WAITED on (the max-queue-wait
+            # slot's), so the stamp and the link tell one story —
+            # attribution for the rare multi-batch request is
+            # approximate by construction (batches are shared).
+            slowest = max(
+                (s for s in slots if s.queue_wait is not None),
+                key=lambda s: s.queue_wait, default=None)
+            if slowest is not None:
+                trace.stamp("queue_wait", slowest.queue_wait)
+                trace.batch = slowest.batch
+            elif slots:
+                trace.batch = slots[-1].batch
         dt = time.perf_counter() - t0
         telemetry.count("serve.requests")
         telemetry.observe("serve.request_s", dt)
@@ -326,13 +361,38 @@ class MicroBatcher:
     def _dispatch(self, batch: list, total: int) -> None:
         t0 = time.perf_counter()
         bucket = self._bucket_for(total)
+        rec = tracing.active()
+        bt = None
+        if rec is not None:
+            # The shared micro-batch span (ISSUE 14): recorded ONCE
+            # per dispatch; member request traces link by batch id and
+            # each slot's queue wait is measured against this moment.
+            bt = rec.begin_batch(bucket, total, len(batch))
+            for slot in batch:
+                slot.queue_wait = t0 - slot.t_enq
+                slot.batch = bt.batch_id
+        bt_registered = False
         try:
             # The hot-swap seam: the engine is resolved HERE, once per
             # batch — a swap between batches is atomic for every
             # request in flight.
             engine = self._engine_fn()
             rows = [r for slot in batch for r in slot.rows]
-            margins, preds, degraded = engine.score_batch(rows, bucket)
+            # Keyword only when tracing: engine-shaped test stubs (and
+            # the tracing-off path) keep the pre-ISSUE-14 signature.
+            margins, preds, degraded = (
+                engine.score_batch(rows, bucket, trace=bt)
+                if bt is not None
+                else engine.score_batch(rows, bucket))
+            if bt is not None:
+                # Register the completed batch BEFORE any member slot
+                # wakes: a handler thread can finish its request (and
+                # look the batch up in the recorder's pending window)
+                # the instant slot.finish releases it — registering in
+                # the finally would race and silently drop the shared
+                # span for that request.
+                rec.finish_batch(bt)
+                bt_registered = True
             lo = 0
             for slot in batch:
                 hi = lo + slot.n
@@ -345,6 +405,11 @@ class MicroBatcher:
                 lo = hi
         except BaseException as e:
             telemetry.thread_exception("serve-batcher", e)
+            if bt is not None and not bt_registered:
+                # Error path: register the partial batch first for the
+                # same reason — failed members' traces still link it.
+                rec.finish_batch(bt)
+                bt_registered = True
             for slot in batch:
                 slot.finish(error=e)
             return

@@ -248,14 +248,19 @@ class ScoringEngine:
         return [ParsedRow(0.0, dict(sparse), dict(dense), dict(ids))
                 for _ in range(n)]
 
-    def _build_chunk(self, rows: list[ParsedRow], R: int
+    def _build_chunk(self, rows: list[ParsedRow], R: int,
+                     timings: dict | None = None
                      ) -> tuple[dict, dict, np.ndarray]:
         """(chunk arrays, per-batch tables, degraded [n] bool) for
         ``rows`` padded to ``R`` — all host numpy; placement is the
         caller's explicit ``device_put``.  ``degraded[i]`` marks row i
         served fixed-effect-only fallback by an entity store
         (ISSUE 13) — per row, so co-batched healthy requests stay
-        unmarked."""
+        unmarked.  ``timings`` (ISSUE 14): accumulates the
+        entity-store lookup seconds under ``"store_lookup"`` so the
+        batch trace can split lookup out of assembly."""
+        import time as _time
+
         n = len(rows)
         k = self.ell_row_capacity
         base = np.zeros(R, np.float32)
@@ -287,7 +292,14 @@ class ScoringEngine:
         degraded = np.zeros(n, bool)
         for name, shard, key, store in self._re:
             ids = np.fromiter((r.ids[key] for r in rows), np.int64, n)
-            w_rows, _hit, deg = store.lookup(ids)
+            if timings is None:
+                w_rows, _hit, deg = store.lookup(ids)
+            else:
+                t_l = _time.perf_counter()
+                w_rows, _hit, deg = store.lookup(ids)
+                timings["store_lookup"] = (
+                    timings.get("store_lookup", 0.0)
+                    + _time.perf_counter() - t_l)
             degraded |= deg
             # Mini-table: row i serves request-row i; row R is the
             # shared zero fallback (unseen entities + padding) — the
@@ -321,19 +333,38 @@ class ScoringEngine:
         chunk["base"] = base
         return chunk, batch_tables, degraded
 
-    def score_batch(self, rows: list[ParsedRow], bucket: int
+    def score_batch(self, rows: list[ParsedRow], bucket: int,
+                    trace=None
                     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Score ``rows`` padded to ``bucket`` → (margins [n],
         predictions [n], degraded [n] bool) as host numpy.  One fused
         device dispatch; ``degraded`` marks the fixed-effect-only
         fallback rows from an unreadable entity-store chunk
-        (ISSUE 13)."""
+        (ISSUE 13).
+
+        ``trace`` (ISSUE 14): the shared ``BatchTrace`` — stage
+        durations stamp onto it (``assemble`` = chunk build minus
+        lookups, ``store_lookup`` = entity-store reads, ``dispatch`` =
+        H2D placement + program enqueue, ``d2h`` = block-until-done +
+        harvest; the async dispatch means device compute time lands in
+        ``d2h``).  None keeps the pre-tracing path: no timestamps
+        taken."""
+        import time as _time
+
         from photon_ml_tpu.reliability import faults
 
         n = len(rows)
         if n > bucket:
             raise ValueError(f"{n} rows > bucket {bucket}")
-        chunk, batch_tables, degraded = self._build_chunk(rows, bucket)
+        timings = None if trace is None else {}
+        t_a = 0.0 if trace is None else _time.perf_counter()
+        chunk, batch_tables, degraded = self._build_chunk(
+            rows, bucket, timings)
+        if trace is not None:
+            lookup_s = timings.get("store_lookup", 0.0)
+            trace.stamp("store_lookup", lookup_s)
+            trace.stamp("assemble",
+                        _time.perf_counter() - t_a - lookup_s)
         # The engine-dispatch fault seam: a wedged/failing device
         # dispatch is injectable here (the batcher maps the error to
         # the whole batch's slots — an answered 500, never a hang).
@@ -342,13 +373,19 @@ class ScoringEngine:
         # contract): the batch chunk and the RE mini-tables go up in
         # one planned device_put; margins/preds come back in one
         # device_get.
+        t_d = 0.0 if trace is None else _time.perf_counter()
         buf = jax.device_put(chunk)
         tables = self._tables
         if batch_tables:
             tables = {**tables, **jax.device_put(batch_tables)}
         m_dev, p_dev = _run_chunk(self.specs, self._mean, tables, buf)
+        t_h = 0.0 if trace is None else _time.perf_counter()
+        if trace is not None:
+            trace.stamp("dispatch", t_h - t_d)
         m = np.asarray(jax.device_get(m_dev)[:n])
         p = np.asarray(jax.device_get(p_dev)[:n])
+        if trace is not None:
+            trace.stamp("d2h", _time.perf_counter() - t_h)
         return m, p, degraded
 
     def warm(self, buckets: list[int]) -> dict:

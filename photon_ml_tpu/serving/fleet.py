@@ -59,6 +59,7 @@ import urllib.request
 from photon_ml_tpu import telemetry
 from photon_ml_tpu.config import ServingConfig, config_to_json
 from photon_ml_tpu.reliability import faults
+from photon_ml_tpu.serving import tracing as _tracing
 from photon_ml_tpu.serving.server import _manifest_signature
 
 logger = logging.getLogger(__name__)
@@ -199,6 +200,39 @@ class SubprocessReplicaLauncher:
             f.write(config_to_json(cfg))
         return path
 
+    @staticmethod
+    def _replica_env() -> dict:
+        """The child environment: inherit, but pin JAX_PLATFORMS to
+        the supervisor's RESOLVED backend when the env does not pin
+        one.  An unset JAX_PLATFORMS makes the replica probe every
+        plugin at its own jax init — on a TPU-less box the libtpu
+        plugin spends MINUTES timing out against the cloud metadata
+        endpoint before falling back to CPU, which reads as a replica
+        that never warms.  Where the env already pins a platform
+        (production images do) this is a no-op."""
+        env = dict(os.environ)  # photon-lint: disable=env-read (whole-environment passthrough for the replica subprocess, not a config-knob read; JAX_PLATFORMS is jax's own variable, not a photon knob for the sanctioned registry)
+        if "JAX_PLATFORMS" not in env:
+            try:
+                import jax
+
+                # Prefer the CONFIGURED platform string (set by e.g.
+                # jax.config.update("jax_platforms", ...) — reading it
+                # initializes nothing); only fall back to
+                # default_backend(), which initializes the supervisor's
+                # backend — a one-time cost here, amortized over every
+                # replica spawn/restart that would otherwise each pay
+                # the full plugin probe.
+                platforms = None
+                try:
+                    platforms = jax.config.jax_platforms
+                except Exception:  # photon-lint: disable=swallowed-exception (older jax without the config attr: fall through to default_backend)
+                    pass
+                env["JAX_PLATFORMS"] = (platforms
+                                        or jax.default_backend())
+            except Exception:  # photon-lint: disable=swallowed-exception (no jax in the supervisor process: the replica resolves its own platform exactly as before)
+                pass
+        return env
+
     def launch(self, idx: int) -> ReplicaHandle:
         cfg_path = self._replica_config_path(idx)
         info_path = os.path.join(self.workdir, f"replica_{idx}.info")
@@ -214,7 +248,7 @@ class SubprocessReplicaLauncher:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "photon_ml_tpu.serving",
                  "--config", cfg_path, "--info-file", info_path],
-                stdout=out, stderr=err)
+                stdout=out, stderr=err, env=self._replica_env())
         finally:
             out.close()
             err.close()
@@ -739,6 +773,7 @@ class FleetServer:
         self._log = run_logger
         self._monitor = None
         self._telemetry = None
+        self._tracer = None
         self._stop_evt = threading.Event()
         self._stop_lock = threading.Lock()
         self._stopped = False
@@ -762,6 +797,15 @@ class FleetServer:
         if cfg.monitor == "on" and _mon.active() is None:
             self._monitor = _mon.start(
                 run_logger=self._log, every_s=cfg.monitor_every_s)
+        if cfg.trace == "on" and _tracing.active() is None:
+            # The frontend-side recorder (ISSUE 14): frontend traces
+            # carry routing/forward/retry stages and join the replica
+            # processes' records by trace id in serve-report.
+            self._tracer = _tracing.start(
+                role="frontend",
+                threshold_s=cfg.trace_threshold_ms / 1e3,
+                sample_every=cfg.trace_sample_every,
+                cap=cfg.trace_buffer, run_logger=self._log)
         self.supervisor.start()
         if self._log is not None:
             self._log.event("fleet_started", port=self.port,
@@ -783,6 +827,8 @@ class FleetServer:
         self._stop_evt.set()
         self.supervisor.stop()
         self.frontend.close()
+        if self._tracer is not None:
+            self._tracer.close()
         if self._monitor is not None:
             self._monitor.close()
         if self._telemetry is not None:

@@ -43,6 +43,7 @@ import urllib.request
 
 from photon_ml_tpu import telemetry
 from photon_ml_tpu.config import ServingConfig
+from photon_ml_tpu.serving import tracing
 from photon_ml_tpu.serving.http import (
     READY,
     STOPPING,
@@ -121,13 +122,17 @@ class FleetFrontend:
 
     # -- request path --------------------------------------------------------
 
-    def _forward(self, url: str, body: bytes, timeout_s: float):
+    def _forward(self, url: str, body: bytes, timeout_s: float,
+                 trace_headers: dict | None = None):
         """One attempt against one replica → (code, payload, ctype,
         headers) for ANY HTTP response; raises a ``_RETRIABLE`` on
-        connection-level failure."""
+        connection-level failure.  ``trace_headers`` propagate the
+        trace context (one more hop) so the replica's trace record
+        joins this request's (ISSUE 14)."""
         req = urllib.request.Request(
             url + "/v1/score", data=body,
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     **(trace_headers or {})})
         try:
             with urllib.request.urlopen(req, timeout=timeout_s) as r:
                 return (r.status, r.read().decode(),
@@ -154,6 +159,15 @@ class FleetFrontend:
 
     def _route_score(self, body: bytes):
         t0 = time.perf_counter()
+        # Request trace (ISSUE 14): minted/adopted by the HTTP core;
+        # forwarded one hop deeper so the replica-side record joins
+        # this one by trace id.  Finished by the core after the write.
+        rt = tracing.begin()
+        ctx = tracing.context()
+        fwd_headers = None
+        if ctx is not None:
+            fwd_headers = {tracing.TRACE_HEADER: ctx.child_header(),
+                           tracing.REQUEST_ID_HEADER: ctx.trace_id}
         deadline = time.monotonic() + self.config.request_timeout_s
         tried: set[int] = set()
         attempt = 0
@@ -162,6 +176,8 @@ class FleetFrontend:
             if replica is None:
                 # Nothing to route to (all down/draining, or the one
                 # untried replica died): shed honestly.
+                if rt is not None:
+                    rt.shed = "no_replica"
                 self._count("shed", "serve.shed")
                 telemetry.count("serve.shed_no_replica")
                 raise HttpError(
@@ -177,13 +193,26 @@ class FleetFrontend:
                 self._count("failed", "serve.frontend_failed")
                 raise HttpError(503, error="request deadline exhausted "
                                            "before a replica answered")
+            t_f = time.perf_counter()
+            if rt is not None and attempt == 1:
+                # Routing cost: route entry → first forward attempt.
+                rt.stamp("route", t_f - t0)
             try:
                 code, payload, ctype, headers = self._forward(
-                    url, body, budget)
+                    url, body, budget, trace_headers=fwd_headers)
             except _RETRIABLE as e:
                 # The replica never answered: count the failure
                 # toward its wedge detection and retry EXACTLY once
                 # on a different replica inside the remaining budget.
+                if rt is not None:
+                    dt = time.perf_counter() - t_f
+                    # Failed-attempt time is the RETRY COST — the
+                    # serve-report decomposition's retry column.
+                    rt.stamp("retry", dt)
+                    rt.attempts.append({
+                        "replica": replica.idx,
+                        "ms": round(dt * 1e3, 3),
+                        "outcome": f"connect_fail:{type(e).__name__}"})
                 self.supervisor.note_failure(replica.idx)
                 remaining = deadline - time.monotonic()
                 retriable = (attempt == 1
@@ -205,6 +234,12 @@ class FleetFrontend:
                                f"{type(e).__name__}: {e}")
             finally:
                 self.supervisor.release_replica(replica)
+            if rt is not None:
+                dt = time.perf_counter() - t_f
+                rt.stamp("forward", dt)
+                rt.attempts.append({"replica": replica.idx,
+                                    "ms": round(dt * 1e3, 3),
+                                    "outcome": code})
             if code == 200:
                 self._count("requests", "serve.requests")
                 telemetry.observe("serve.request_s",
@@ -239,10 +274,14 @@ class FleetFrontend:
             }
 
     def _route_status(self, body: bytes):
+        rec = tracing.active()
+        stages = tracing.stage_summary()
         st = {
             "state": self.readiness.state,
             "frontend": self.stats(),
             "fleet": self.supervisor.status(),
+            **({"tracing": rec.snapshot()} if rec is not None else {}),
+            **({"stages": stages} if stages else {}),
         }
         mon = _mon.active()
         if mon is not None:

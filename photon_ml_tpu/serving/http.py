@@ -29,8 +29,19 @@ the process.  Route errors can carry extra response headers
 (``HttpError(..., headers={"Retry-After": "1"})`` — the overload-shed
 contract).
 
+Request identity (ISSUE 14): every dispatch adopts (or mints) a trace
+context from ``X-Photon-Trace`` / ``X-Photon-Request-Id`` and echoes
+``X-Photon-Request-Id`` on EVERY response — 404s, 413s, 500s, 503
+sheds, and retry-exhausted 502s included — so a client can correlate
+ANY outcome with fleet ``/status`` and the run logs.  Routes read the
+context via ``tracing.context()``; a route that began a
+``RequestTrace`` leaves it attached and the core stamps the
+response-write stage and finishes it after the bytes go out.
+
 Import discipline: stdlib only — ``telemetry.monitor`` imports this
-module, so anything heavier would cycle through the package.
+module, so anything heavier would cycle through the package
+(``serving.tracing`` is stdlib-only at import time for the same
+reason).
 """
 
 from __future__ import annotations
@@ -40,6 +51,9 @@ import json
 import logging
 import socket
 import threading
+import time
+
+from photon_ml_tpu.serving import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -118,13 +132,19 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def _send(self, code: int, body: str, ctype: str,
               headers: dict | None = None) -> None:
         data = body.encode()
+        t0 = time.perf_counter()
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         for k, v in (headers or {}).items():
             self.send_header(k, str(v))
+        # The request-id echo contract (ISSUE 14): EVERY response —
+        # sheds and errors included — carries the trace identity.
+        for k, v in (getattr(self, "_trace_hdrs", None) or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
+        self._sent = (code, time.perf_counter() - t0)
 
     def _send_json(self, code: int, obj,
                    headers: dict | None = None) -> None:
@@ -132,6 +152,28 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                    headers=headers)
 
     def _dispatch(self, method: str) -> None:
+        ctx = tracing.from_headers(self.headers)
+        tracing.set_context(ctx)
+        self._trace_hdrs = {
+            tracing.REQUEST_ID_HEADER: ctx.trace_id,
+            tracing.TRACE_HEADER: ctx.header_value(),
+        }
+        self._sent = None
+        try:
+            self._dispatch_routed(method)
+        finally:
+            # A route that began a RequestTrace left it attached: the
+            # write stage is the send the core just performed, and the
+            # finish here covers EVERY outcome (200s, sheds, 500s).
+            rt = tracing.take_attached()
+            if rt is not None:
+                sent = self._sent
+                if sent is not None:
+                    rt.stamp("write", sent[1])
+                tracing.finish(rt, status=sent[0] if sent else None)
+            tracing.clear()
+
+    def _dispatch_routed(self, method: str) -> None:
         ep = self.endpoint
         path = self.path.split("?", 1)[0]
         if path in ("/", "/healthz"):

@@ -47,6 +47,7 @@ from photon_ml_tpu.serving.batcher import (
     ServerOverloaded,
     ServerSaturated,
 )
+from photon_ml_tpu.serving import tracing
 from photon_ml_tpu.serving.engine import BadRequest, ScoringEngine
 from photon_ml_tpu.serving.http import (
     READY,
@@ -111,6 +112,7 @@ class ModelServer:
         self._stopped = False
         self._monitor = None
         self._telemetry = None
+        self._tracer = None
         self.swaps = 0
         self.swap_failures = 0
         self.last_swap_error: str | None = None
@@ -141,6 +143,12 @@ class ModelServer:
         if cfg.monitor == "on" and _mon.active() is None:
             self._monitor = _mon.start(
                 run_logger=self._log, every_s=cfg.monitor_every_s)
+        if cfg.trace == "on" and tracing.active() is None:
+            self._tracer = tracing.start(
+                role="replica",
+                threshold_s=cfg.trace_threshold_ms / 1e3,
+                sample_every=cfg.trace_sample_every,
+                cap=cfg.trace_buffer, run_logger=self._log)
         try:
             engine = self._load_engine()
             engine.warm(cfg.buckets())
@@ -192,6 +200,8 @@ class ModelServer:
             engine, self._engine = self._engine, None
         if engine is not None:
             engine.close()
+        if self._tracer is not None:
+            self._tracer.close()
         if self._monitor is not None:
             self._monitor.close()
         if self._telemetry is not None:
@@ -302,6 +312,10 @@ class ModelServer:
         }
 
     def _route_score(self, body: bytes):
+        # Request trace (ISSUE 14): begun here, finished by the HTTP
+        # core after the response write — sheds and errors included.
+        t0 = time.perf_counter()
+        rt = tracing.begin()
         if self.readiness.state != READY:
             state, reason = self.readiness.snapshot()
             raise HttpError(503, error=f"server is {state}",
@@ -320,7 +334,8 @@ class ModelServer:
             raise HttpError(400, error=str(e))
         try:
             margins, preds, version, degraded = self._batcher.submit(
-                parsed, timeout_s=self.config.request_timeout_s)
+                parsed, timeout_s=self.config.request_timeout_s,
+                trace=rt, t_admit=t0)
         except ServerSaturated as e:
             raise HttpError(429, error=str(e), headers={
                 "Retry-After": f"{e.retry_after_s:.0f}"})
@@ -328,6 +343,8 @@ class ModelServer:
             # Overload sheds (admission control / queued-past-deadline)
             # answer 503 + Retry-After: a fast, honest "not now", never
             # a queue-collapse timeout.
+            if rt is not None and rt.shed is None:
+                rt.shed = "deadline"
             raise HttpError(503, error=str(e), headers={
                 "Retry-After": f"{e.retry_after_s:.0f}"})
         except ServerClosing as e:
@@ -336,18 +353,26 @@ class ModelServer:
             raise HttpError(503, error=str(e))
         if degraded:
             telemetry.count("serve.degraded_responses")
+        t_ser = 0.0 if rt is None else time.perf_counter()
         out = {"margins": [float(v) for v in margins],
                "predictions": [float(v) for v in preds],
                "model_version": version,
                "n": int(len(margins)),
                **({"degraded": True} if degraded else {})}
-        return 200, json.dumps(out), "application/json"
+        payload_json = json.dumps(out)
+        if rt is not None:
+            rt.stamp("serialize", time.perf_counter() - t_ser)
+            rt.rows = int(len(margins))
+            rt.degraded = bool(degraded)
+        return 200, payload_json, "application/json"
 
     def serving_status(self) -> dict:
         with self._lock:
             engine = self._engine
             swaps, failures = self.swaps, self.swap_failures
             last_err = self.last_swap_error
+        rec = tracing.active()
+        stages = tracing.stage_summary()
         return {
             "state": self.readiness.state,
             "uptime_s": round(time.monotonic() - self.t0, 1),
@@ -357,6 +382,8 @@ class ModelServer:
             "swaps": swaps,
             "swap_failures": failures,
             **({"last_swap_error": last_err} if last_err else {}),
+            **({"tracing": rec.snapshot()} if rec is not None else {}),
+            **({"stages": stages} if stages else {}),
             "peak_rss_mb": _peak_rss_mb(),
         }
 

@@ -513,6 +513,30 @@ class Telemetry:
                     del h["reservoir"][::2]
                     h["stride"] *= 2
 
+    def histogram_quantiles(self, prefix: str, qs: tuple
+                            ) -> dict:
+        """{name: {count, quantiles: [per q]}} for every histogram
+        whose name starts with ``prefix`` (ISSUE 14): the serving
+        tier's stage table polls a handful of ``serve.stage.*``
+        histograms per /status request — this sorts ONLY the matching
+        reservoirs under the lock instead of snapshotting the whole
+        registry the way ``summary()`` does."""
+        out = {}
+        with self._lock:
+            # Copy under the lock, sort OUTSIDE it — the whole point
+            # is not stalling request-path observe() calls.
+            matching = [(name, h["count"], list(h["reservoir"]))
+                        for name, h in self._hists.items()
+                        if name.startswith(prefix)]
+        for name, count, res in matching:
+            res.sort()
+            out[name] = {
+                "count": count,
+                "quantiles": [_reservoir_quantile(res, q)
+                              for q in qs],
+            }
+        return out
+
     def percentile(self, name: str, q: float) -> float | None:
         """Quantile ``q`` in [0, 1] of histogram ``name`` from its
         bounded reservoir (ISSUE 8 satellite).

@@ -1,5 +1,5 @@
 """Telemetry CLI: ``python -m photon_ml_tpu.telemetry
-<report|history|watch>``.
+<report|history|watch|serve-report>``.
 
 ``report <log>`` prints the per-phase / stage-span / overlap /
 convergence / device / reconciliation report for a run's
@@ -20,6 +20,14 @@ trajectory, reliability counters, active alerts — that exits when the
 run logs ``done`` (or ``--once`` for a single snapshot); see
 ``telemetry.watch``.
 
+``serve-report <logs...>`` joins the serving fleet's sampled request
+traces across processes by trace id (ISSUE 14) into a stage-level
+latency-decomposition table (p50/p99 per stage, retry cost, dominant
+stage per tail request) and optionally exports a Perfetto flow trace
+(``--trace-out``); exit code 1 when no trace records are found or the
+cross-process join falls below ``--join-threshold``; see
+``telemetry.serve_report``.
+
 All subcommands print one machine-parseable JSON object as the last
 stdout line (the repo's CLI contract).
 """
@@ -29,6 +37,7 @@ from __future__ import annotations
 import argparse
 import sys
 
+from photon_ml_tpu.telemetry import serve_report as serve_report_mod
 from photon_ml_tpu.telemetry import watch as watch_mod
 from photon_ml_tpu.telemetry.history import (
     DEFAULT_TOLERANCE,
@@ -89,7 +98,30 @@ def main(argv=None) -> int:
                     help="give up following after this many seconds "
                          "without a done event (a killed run's log "
                          "stops growing but never finishes)")
+    sp = sub.add_parser(
+        "serve-report",
+        help="join frontend + replica request traces by trace id into "
+             "a cross-process stage-latency decomposition (p50/p99 "
+             "per stage, retry cost, dominant stage per tail request)")
+    sp.add_argument("logs", nargs="+",
+                    help="serving run logs (the frontend's and each "
+                         "replica's run_log JSONL; one server's log "
+                         "also works — the join check is then N/A)")
+    sp.add_argument("--join-threshold", type=float,
+                    default=serve_report_mod.DEFAULT_JOIN_THRESHOLD,
+                    help="minimum fraction of replica-side tail "
+                         "requests that must match a frontend trace "
+                         "(default "
+                         f"{serve_report_mod.DEFAULT_JOIN_THRESHOLD})")
+    sp.add_argument("--trace-out", default=None,
+                    help="also write a Perfetto-loadable Chrome trace "
+                         "with cross-process flow events here")
     args = p.parse_args(argv)
+    if args.cmd == "serve-report":
+        result = serve_report_mod.run_serve_report(
+            args.logs, join_threshold=args.join_threshold,
+            trace_out=args.trace_out)
+        return 0 if result["ok"] else 1
     if args.cmd == "watch":
         snap = watch_mod.watch(args.log, once=args.once,
                                interval_s=args.interval,

@@ -78,6 +78,12 @@ METRICS: tuple[tuple[str, str, str], ...] = (
     # creep.
     ("serve", "serve.failed_requests", "lower"),
     ("serve", "serve.restart_s", "lower"),
+    # Request tracing (ISSUE 14): the stage medians the tracing tier
+    # decomposes the tail into — queue wait creeping up means the
+    # batcher is becoming the bottleneck, dispatch creeping up means
+    # the device path regressed; both gate like every other metric.
+    ("serve", "serve.queue_wait_ms", "lower"),
+    ("serve", "serve.dispatch_ms", "lower"),
 )
 
 

@@ -118,6 +118,16 @@ DEFAULT_THRESHOLDS: dict = {
     # under-provisioned and an operator must see it.
     "serve_shed_fraction": 0.2,
     "serve_shed_min_events": 20,
+    # serve_queue_wait (ISSUE 14): the batcher-is-the-bottleneck
+    # signal — the queue-wait stage's p99 (from the request-tracing
+    # tier's serve.stage.queue_wait_s histogram) exceeding this
+    # fraction of the end-to-end request p99, once at least
+    # queue_wait_min_requests requests are on record.  A tail
+    # dominated by queue wait means requests are waiting on batch
+    # formation/device capacity, not on the work itself — add
+    # replicas or widen buckets rather than chasing the engine.
+    "queue_wait_fraction": 0.5,
+    "queue_wait_min_requests": 20,
 }
 
 _ACTIVE: "Monitor | None" = None
@@ -353,9 +363,19 @@ class Monitor:
                 **({"phase": phase} if phase else {}),
                 **fields,
             }
-        self._log.event("progress", **rec)
         t = self._session if self._session is not None \
             else telemetry.active()
+        if stage == "serve" and t is not None:
+            # Serve progress snapshots carry the stage-latency table
+            # (ISSUE 14) so `telemetry watch` renders the serve stage
+            # decomposition live — cadence-throttled with the event,
+            # zero cost on the hot path.
+            from photon_ml_tpu.serving import tracing as _tracing
+
+            stage_tbl = _tracing.stage_summary(session=t)
+            if stage_tbl:
+                rec["stages_ms"] = stage_tbl
+        self._log.event("progress", **rec)
         if t is not None:
             t.count("monitor.progress_events")
         self._evaluate_alerts(now)
@@ -463,14 +483,46 @@ class Monitor:
         if (p99 is not None
                 and t.counter("serve.requests") >= th["serve_min_requests"]
                 and p99 > th["serve_p99_s"]):
+            # Name the dominant stage (ISSUE 14): with request tracing
+            # on, the serve.stage.* histograms say WHERE the tail goes
+            # — the alert carries the first diagnostic step.
+            from photon_ml_tpu.serving import tracing as _tracing
+
+            dom = _tracing.dominant_stage(
+                _tracing.stage_summary(session=t))
             self._fire(
                 "serve_tail_latency", "serve",
                 f"p99 request latency {p99 * 1e3:.1f} ms exceeds the "
                 f"{th['serve_p99_s'] * 1e3:.0f} ms threshold; the "
-                "serving tier is missing its tail SLO",
+                "serving tier is missing its tail SLO"
+                + (f" (dominant stage: {dom[0]}, p99 {dom[1]:.1f} ms)"
+                   if dom is not None else ""),
                 p99_ms=round(p99 * 1e3, 2),
                 threshold_ms=round(th["serve_p99_s"] * 1e3, 2),
-                requests=t.counter("serve.requests"))
+                requests=t.counter("serve.requests"),
+                **({"dominant_stage": dom[0],
+                    "dominant_p99_ms": dom[1]} if dom is not None
+                   else {}))
+        # serve_queue_wait (ISSUE 14): queue wait dominating the
+        # request tail IS the "batcher is the bottleneck" signal —
+        # per-request wait vs shared compute is exactly the split the
+        # tracing tier measures.  Latched like every rule.
+        qw_p99 = t.percentile("serve.stage.queue_wait_s", 0.99)
+        if (qw_p99 is not None and p99 is not None and p99 > 0
+                and t.counter("serve.requests")
+                >= th["queue_wait_min_requests"]
+                and qw_p99 > th["queue_wait_fraction"] * p99):
+            self._fire(
+                "serve_queue_wait", "serve",
+                f"p99 queue wait {qw_p99 * 1e3:.1f} ms is "
+                f"{qw_p99 / p99:.0%} of the p99 request latency "
+                f"{p99 * 1e3:.1f} ms (threshold "
+                f"{th['queue_wait_fraction']:.0%}); the micro-batcher "
+                "is the bottleneck — add replicas or raise batch "
+                "capacity",
+                queue_wait_p99_ms=round(qw_p99 * 1e3, 2),
+                request_p99_ms=round(p99 * 1e3, 2),
+                fraction=round(qw_p99 / p99, 3))
         # serve_shed_rate (ISSUE 13): the 429/503 shed fraction over
         # the rolling window.  Both legs come from the registry's
         # windowed counter rates, so one ancient burst of sheds cannot
@@ -615,7 +667,29 @@ def prometheus_text(monitor: "Monitor | None" = None,
             pn = _prom_name(name)
             lines.append(f"# TYPE {pn} gauge")
             lines.append(f"{pn} {g['last']}")
+        stage_family = False
         for name, h in s.get("histograms", {}).items():
+            if name.startswith("serve.stage.") and name.endswith("_s"):
+                # The request-tracing stage histograms export as ONE
+                # labeled family (ISSUE 14): a dashboard slices
+                # photon_serve_stage_seconds{stage="queue_wait"}
+                # against its siblings instead of discovering N
+                # flat-named series.
+                stage = _prom_label(name[len("serve.stage."):-2])
+                pn = "photon_serve_stage_seconds"
+                if not stage_family:
+                    lines.append(f"# TYPE {pn} summary")
+                    stage_family = True
+                for q, key in ((0.5, "p50"), (0.95, "p95"),
+                               (0.99, "p99")):
+                    if h.get(key) is not None:
+                        lines.append(
+                            f'{pn}{{stage="{stage}",quantile="{q}"}} '
+                            f'{h[key]}')
+                lines.append(f'{pn}_count{{stage="{stage}"}} '
+                             f"{h['count']}")
+                lines.append(f'{pn}_sum{{stage="{stage}"}} {h["sum"]}')
+                continue
             pn = _prom_name(name)
             lines.append(f"# TYPE {pn} summary")
             for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):

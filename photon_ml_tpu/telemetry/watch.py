@@ -110,6 +110,18 @@ def snapshot(path: str) -> dict:
         if current is None or (st.get("t") or 0) > (
                 stages[current].get("t") or 0):
             current = name
+    # Serve stage table (ISSUE 14): the newest serve progress event
+    # carries the request-tracing tier's per-stage p50/p99 — watch
+    # renders the live latency decomposition, and the dominant stage
+    # is the one with the largest p99.
+    serve_stages = (stages.get("serve") or {}).get("stages_ms") or None
+    dominant = None
+    if serve_stages:
+        best = max(((s, e.get("p99_ms")) for s, e in serve_stages.items()
+                    if e.get("p99_ms") is not None),
+                   key=lambda kv: kv[1], default=None)
+        if best is not None:
+            dominant = {"stage": best[0], "p99_ms": best[1]}
     torn = sum(1 for ev in all_events
                if ev.get("event") == "_malformed_line")
     return {
@@ -127,6 +139,8 @@ def snapshot(path: str) -> dict:
                  if current is not None else None),
         "losses": losses,
         "lanes": lanes,
+        "serve_stages": serve_stages,
+        "serve_dominant": dominant,
         "alerts": alerts,
         "heartbeats": beats,
         "thread_exceptions": deaths,
@@ -186,6 +200,19 @@ def render(snap: dict, out=None) -> None:
         w(f"  lanes[{snap['lanes']['label'] or 'swept'}] iter "
           f"{snap['lanes']['iteration']}: "
           + " ".join(f"{v:.6g}" for v in vals))
+    if snap.get("serve_stages"):
+        w("  serve stages (request tracing):")
+        w(f"    {'stage':<14} {'count':>7} {'p50_ms':>9} {'p99_ms':>9}")
+        for stage, ent in snap["serve_stages"].items():
+            p50 = ent.get("p50_ms")
+            p99 = ent.get("p99_ms")
+            w(f"    {stage:<14} {ent.get('count', 0):>7} "
+              f"{(f'{p50:.3f}' if p50 is not None else '-'):>9} "
+              f"{(f'{p99:.3f}' if p99 is not None else '-'):>9}")
+        dom = snap.get("serve_dominant")
+        if dom:
+            w(f"    dominant stage: {dom['stage']} "
+              f"(p99 {dom['p99_ms']:.3f} ms)")
     if snap["heartbeats"]:
         w("  heartbeats: " + ", ".join(
             f"{s}={n}" for s, n in sorted(snap["heartbeats"].items())))

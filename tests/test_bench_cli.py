@@ -347,6 +347,38 @@ def test_history_spec_watches_serve_fleet():
     assert directions["serve:serve.restart_s"] == "lower"
 
 
+@pytest.mark.fast
+def test_history_spec_watches_serve_stage_medians():
+    """ISSUE 14 satellite: the history spec gates the request-tracing
+    stage medians — queue wait creeping up (batcher becoming the
+    bottleneck) and dispatch creeping up (device path regressing) are
+    history-gated like everything else."""
+    from photon_ml_tpu.telemetry.history import METRICS, detect
+
+    keys = {(s, p) for s, p, _ in METRICS}
+    assert ("serve", "serve.queue_wait_ms") in keys
+    assert ("serve", "serve.dispatch_ms") in keys
+    directions = {f"{s}:{p}": d for s, p, d in METRICS}
+    assert directions["serve:serve.queue_wait_ms"] == "lower"
+    assert directions["serve:serve.dispatch_ms"] == "lower"
+    # Contract: an injected 2x queue-wait regression gates (rc-1
+    # shape) while a flat trajectory stays clean.
+    rounds = [
+        {"name": f"r{i}", "rc": 0,
+         "record": {"serve": {"queue_wait_ms": 2.0,
+                              "dispatch_ms": 3.0}}}
+        for i in range(3)
+    ]
+    assert detect(rounds)["ok"] is True
+    rounds.append({"name": "r3", "rc": 0,
+                   "record": {"serve": {"queue_wait_ms": 4.0,
+                                        "dispatch_ms": 3.0}}})
+    result = detect(rounds)
+    assert result["ok"] is False
+    assert [r["metric"] for r in result["regressions"]] == \
+        ["serve:serve.queue_wait_ms"]
+
+
 @pytest.mark.slow   # server subprocess + client storm
 def test_bench_serve_section_contract(tmp_path):
     """`--section serve` keeps the budget/JSON-last-line contract and
@@ -355,7 +387,7 @@ def test_bench_serve_section_contract(tmp_path):
     margin parity vs the batch scorer, the server's own peak RSS, and
     the server subprocess's clean rc."""
     proc = _run_bench(tmp_path, "--section", "serve",
-                      "--budget-s", "420", *_TINY, timeout=560)
+                      "--budget-s", "480", *_TINY, timeout=640)
     assert proc.returncode == 0, proc.stderr[-3000:]
     rec = json.loads(
         [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
@@ -373,6 +405,13 @@ def test_bench_serve_section_contract(tmp_path):
     assert s["server_peak_rss_mb"] > 0
     assert s["server_rc"] == 0
     assert rec["peak_rss_mb"]["serve"] > 0
+    # Request tracing (ISSUE 14): stage medians recorded for the
+    # history gate, and the paired tracing off/on A/B measured.
+    assert s["queue_wait_ms"] is not None and s["queue_wait_ms"] > 0
+    assert s["dispatch_ms"] is not None and s["dispatch_ms"] > 0
+    ov = s["trace_overhead"]
+    assert ov["p50_off_ms"] > 0 and ov["p50_on_ms"] > 0
+    assert ov["overhead_frac"] is not None
     # Fleet arm (ISSUE 13): 2 replicas, one SIGKILLed mid-storm —
     # zero failed client requests, the restart latency measured, the
     # shed fraction reported, and a clean frontend exit.
@@ -386,6 +425,15 @@ def test_bench_serve_section_contract(tmp_path):
     assert f["requests"] > 0
     assert f["restarts"] >= 1
     assert f["frontend_rc"] == 0
+    # Cross-process trace join (ISSUE 14 acceptance): the frontend's
+    # and replicas' trace logs join by trace id at >= 99%, and the
+    # SIGKILL guarantees retried requests exercised the retry column.
+    tj = s["trace_join"]
+    assert tj is not None and "error" not in tj, tj
+    assert tj["ok"] is True
+    assert tj["join_fraction"] is None or tj["join_fraction"] >= 0.99
+    assert tj["retried_requests"] >= 1
+    assert tj["dominant_stage"] is not None
 
 
 def test_bench_history_dir_appends_envelope(tmp_path):

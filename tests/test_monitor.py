@@ -988,3 +988,162 @@ def test_divergence_alert_visible_in_watch_status_and_report(
     assert rc == 0
     assert [a["rule"] for a in tail["alerts"]] == ["loss_diverging"]
     assert "Alerts" in out and "loss_diverging" in out
+
+
+# ---------------------------------------------------------------------------
+# Request tracing (ISSUE 14): serve_queue_wait rule, dominant-stage
+# naming, the labeled stage family, and the watch stage table
+# ---------------------------------------------------------------------------
+
+
+def test_serve_queue_wait_fires_when_batcher_dominates_latched():
+    """ISSUE 14 satellite (positive): queue-wait p99 above the
+    configured fraction of the request p99 fires serve_queue_wait
+    exactly once — the 'batcher is the bottleneck' signal."""
+    clock = _FakeClock()
+    reg = _registry(clock)
+    m, sink, _ = _monitor(clock=clock, session=reg)
+    for _ in range(30):
+        reg.count("serve.requests")
+        reg.observe("serve.request_s", 0.100)
+        reg.observe("serve.stage.queue_wait_s", 0.080)   # 80% wait
+    clock.tick(0.5)
+    m.progress("serve", 30, unit="requests")
+    assert _rules(sink) == ["serve_queue_wait"]
+    alert = sink.of("alert")[0]
+    assert alert["stage"] == "serve"
+    assert alert["fraction"] == pytest.approx(0.8, abs=0.05)
+    assert "batcher" in alert["message"]
+    # Latched: the next snapshot re-fires nothing.
+    clock.tick(0.5)
+    m.progress("serve", 60, unit="requests")
+    assert _rules(sink) == ["serve_queue_wait"]
+    m.close()
+
+
+def test_serve_queue_wait_negative_paths():
+    """ISSUE 14 satellite (negative): a compute-dominated tail never
+    fires, and a wait-dominated tail below the minimum request count
+    is start-up noise."""
+    clock = _FakeClock()
+    reg = _registry(clock)
+    m, sink, _ = _monitor(clock=clock, session=reg)
+    for _ in range(50):                   # 10% queue wait: healthy
+        reg.count("serve.requests")
+        reg.observe("serve.request_s", 0.100)
+        reg.observe("serve.stage.queue_wait_s", 0.010)
+    clock.tick(0.5)
+    m.progress("serve", 50, unit="requests")
+    assert _rules(sink) == []
+    m.close()
+
+    clock2 = _FakeClock()
+    reg2 = _registry(clock2)
+    m2, sink2, _ = _monitor(clock=clock2, session=reg2)
+    for _ in range(5):                    # dominated, but too few
+        reg2.count("serve.requests")
+        reg2.observe("serve.request_s", 0.100)
+        reg2.observe("serve.stage.queue_wait_s", 0.090)
+    clock2.tick(0.5)
+    m2.progress("serve", 5, unit="requests")
+    assert _rules(sink2) == []
+    m2.close()
+
+
+def test_serve_tail_latency_names_dominant_stage():
+    """ISSUE 14: with the stage histograms populated, the
+    serve_tail_latency alert names the dominant stage — the first
+    diagnostic step rides the page."""
+    clock = _FakeClock()
+    reg = _registry(clock)
+    m, sink, _ = _monitor(clock=clock, session=reg)
+    for _ in range(30):
+        reg.count("serve.requests")
+        reg.observe("serve.request_s", 0.9)
+        reg.observe("serve.stage.dispatch_s", 0.7)
+        reg.observe("serve.stage.queue_wait_s", 0.1)
+    clock.tick(0.5)
+    m.progress("serve", 30, unit="requests")
+    assert _rules(sink) == ["serve_tail_latency"]
+    alert = sink.of("alert")[0]
+    assert alert["dominant_stage"] == "dispatch"
+    assert "dominant stage: dispatch" in alert["message"]
+    m.close()
+
+
+def test_serve_progress_event_carries_stage_table():
+    """Serve progress snapshots embed the stage p50/p99 table so
+    `telemetry watch` renders the live latency decomposition."""
+    clock = _FakeClock()
+    reg = _registry(clock)
+    m, sink, _ = _monitor(clock=clock, session=reg)
+    for _ in range(4):
+        reg.observe("serve.stage.queue_wait_s", 0.004)
+        reg.observe("serve.stage.dispatch_s", 0.002)
+    clock.tick(0.5)
+    m.progress("serve", 4, unit="requests")
+    prog = sink.of("progress")[0]
+    assert prog["stages_ms"]["queue_wait"]["p50_ms"] == pytest.approx(
+        4.0, rel=0.01)
+    assert prog["stages_ms"]["dispatch"]["count"] == 4
+    # Non-serve stages stay lean: no table attached.
+    clock.tick(0.5)
+    m.progress("solver", 1, 10, unit="iters")
+    assert "stages_ms" not in sink.of("progress")[-1]
+    m.close()
+
+
+def test_prometheus_serve_stage_labeled_family():
+    """serve.stage.<stage>_s histograms export as ONE labeled family
+    photon_serve_stage_seconds{stage=...} (ISSUE 14) instead of N
+    flat-named series; other histograms keep the flat form."""
+    clock = _FakeClock()
+    reg = _registry(clock)
+    for _ in range(10):
+        reg.observe("serve.stage.queue_wait_s", 0.004)
+        reg.observe("serve.stage.dispatch_s", 0.002)
+        reg.observe("serve.request_s", 0.01)
+    text = monitor.prometheus_text(session=reg)
+    lines = text.splitlines()
+    assert lines.count("# TYPE photon_serve_stage_seconds summary") == 1
+    assert any(l.startswith(
+        'photon_serve_stage_seconds{stage="queue_wait",quantile="0.5"}')
+        for l in lines)
+    assert 'photon_serve_stage_seconds_count{stage="dispatch"} 10' \
+        in lines
+    # The plain request histogram keeps the flat exposition.
+    assert "# TYPE photon_serve_request_s summary" in lines
+    assert not any("photon_serve_stage_queue_wait" in l for l in lines)
+
+
+def test_watch_renders_serve_stage_table(tmp_path, capsys):
+    """ISSUE 14 satellite: watching a SERVER run log renders the serve
+    stage table (p50/p99 per stage) and the dominant-stage line."""
+    path = str(tmp_path / "serve_log.jsonl")
+    log = RunLogger(path, run_info={"driver": "serving"})
+    log.event("progress", stage="serve", done=100.0, unit="rows",
+              stages_ms={
+                  "queue_wait": {"count": 40, "p50_ms": 2.1,
+                                 "p99_ms": 9.5},
+                  "dispatch": {"count": 12, "p50_ms": 3.3,
+                               "p99_ms": 6.2},
+              })
+    log.close()
+    rc = telemetry_main(["watch", path, "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    snap = json.loads(out.strip().splitlines()[-1])
+    assert snap["serve_stages"]["queue_wait"]["p99_ms"] == 9.5
+    assert snap["serve_dominant"] == {"stage": "queue_wait",
+                                      "p99_ms": 9.5}
+    assert "serve stages (request tracing):" in out
+    assert "dominant stage: queue_wait" in out
+    # A training log (no serve stage) renders no serve table.
+    path2 = str(tmp_path / "train_log.jsonl")
+    _write_live_log(path2, done=True)
+    rc = telemetry_main(["watch", path2, "--once"])
+    out2 = capsys.readouterr().out
+    assert rc == 0
+    snap2 = json.loads(out2.strip().splitlines()[-1])
+    assert snap2["serve_stages"] is None
+    assert "serve stages" not in out2

@@ -62,10 +62,17 @@ PARITY_TOL = 1e-5   # same f32 fused program, same op order
 @pytest.fixture(autouse=True)
 def _no_leaked_sessions():
     """Server tests must leave the module-global telemetry/monitor
-    sessions closed (the test_monitor discipline)."""
+    sessions closed (the test_monitor discipline) — and since ISSUE 14
+    the trace recorder too (servers start one by default)."""
+    from photon_ml_tpu.serving import tracing as _tracing
+
     assert _mon.active() is None and telemetry.active() is None
+    assert _tracing.active() is None
     yield
     leaked = []
+    if _tracing.active() is not None:
+        _tracing.active().close()
+        leaked.append("tracing")
     if _mon.active() is not None:
         _mon.active().close()
         leaked.append("monitor")
@@ -407,13 +414,15 @@ class _FakeEngine:
         self.delay_s = delay_s
         self._lock = threading.Lock()
 
-    def score_batch(self, rows, bucket):
+    def score_batch(self, rows, bucket, trace=None):
         with self._lock:
             self.calls.append((len(rows), bucket))
         if self.fail:
             raise RuntimeError("device on fire")
         if self.delay_s:
             time.sleep(self.delay_s)
+        if trace is not None:
+            trace.stamp("dispatch", 1e-4)
         vals = np.asarray(rows, np.float32)
         return vals, vals * 2.0, np.zeros(len(rows), bool)
 
