@@ -78,7 +78,7 @@ V5E_PEAK_GBPS = PLATFORM_PEAK_GBPS["tpu"][0]
 DEFAULT_SECTIONS = ("etl", "cached", "grr", "segment_sum", "colmajor")
 ALL_SECTIONS = DEFAULT_SECTIONS + ("powerlaw", "chunked", "sweep",
                                    "stream", "score", "re", "cd_fused",
-                                   "serve")
+                                   "serve", "mesh_stream")
 DEFAULT_BUDGET_S = 840.0
 DEFAULT_N, DEFAULT_D, DEFAULT_K = 1_000_000, 100_000, 30
 
@@ -134,6 +134,21 @@ CDF_FUSED_CYCLES = 40
 CDF_LEGACY_ITERS = 4
 CDF_LEGACY_MAX_ITERS = 15
 CDF_D_RE = 4
+
+# Multi-host mesh-stream section shape (ISSUE 16): MESH_HOSTS worker
+# processes chunk-synchronized over one shared chunk grid — each host
+# streams only its contiguous shard (4 of 12 chunks) from a per-host
+# spill subdir and the per-chunk partials cross hosts once per chunk
+# step.  The shard must still dwarf the host window (4/2 = 2× per
+# host, 12/2 = 6× fleet-wide) so per-host RSS stays a real claim, and
+# the fused cycle count is small: the section measures the fleet
+# schedule (barrier wait, reduces, replicated odometer), not
+# convergence endurance.
+MESH_HOSTS = 3
+MESH_CHUNKS = 12
+MESH_WINDOW = 2
+MESH_DEPTH = 2
+MESH_CYCLES = 10
 
 # Serve section shape (ISSUE 12): a subprocess-isolated model server
 # (honest per-process RSS, real socket path) under SERVE_CLIENTS
@@ -209,6 +224,12 @@ SECTION_EST_S = {
     # ~6 s storm with a mid-run SIGKILL, the restart-latency wait, and
     # the serve-report cross-process trace join.
     "serve": 480.0,
+    # MESH_HOSTS concurrent worker subprocesses on a small box: each
+    # pays the jax import + fused-program compile + full-dataset build,
+    # then MESH_CYCLES chunk-synchronized fused passes over 1/HOSTS of
+    # the chunks (the passes themselves are ~1/HOSTS of a cd_fused
+    # pass, but the fixed per-worker costs dominate at bench shapes).
+    "mesh_stream": 480.0,
 }
 
 
@@ -357,6 +378,11 @@ class BenchContext:
             # Two subprocess arms pay a fixed jax-import + compile cost
             # each, regardless of shape.
             est += 60.0
+        elif section == "mesh_stream":
+            # MESH_HOSTS concurrent workers each pay the fixed
+            # jax-import + compile cost (concurrent, but the box is
+            # small — charge them near-serially).
+            est += 40.0 * MESH_HOSTS
         # Sections that need the GRR plan pay a COLD build first when
         # neither a resident pair nor a cache file exists (e.g. etl was
         # skipped or never ran) — charge it, or a section admitted
@@ -1794,6 +1820,261 @@ def section_cd_fused(ctx: BenchContext) -> None:
           file=sys.stderr)
 
 
+def mesh_arm_main(args) -> int:
+    """One HOST of the ``mesh_stream`` section in its own process:
+    joins the fleet named by the environment (the ``jax.distributed``
+    env trio → psum transport; the ``PHOTON_FLEET_*`` trio → tcp
+    transport; neither → a single-host control run), trains the shared
+    fused-CD workload over ITS chunk shard with a per-host spill
+    subdir, and writes the per-host ``run_log.jsonl`` the parent's
+    fleet-report join consumes.  Emits one JSON line; saves final
+    coefficients for the parent's cross-host bitwise-identity check."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.config import (
+        CoordinateConfig,
+        CoordinateKind,
+        OptimizerSettings,
+        TrainingConfig,
+        read_env,
+    )
+    from photon_ml_tpu.estimators.game_estimator import GameEstimator
+    from photon_ml_tpu.models.glm import TaskType
+    from photon_ml_tpu.parallel import fleet
+    from photon_ml_tpu.utils.run_log import RunLogger
+
+    if read_env("JAX_COORDINATOR_ADDRESS"):
+        from photon_ml_tpu.cli.game_training_driver import (
+            distributed_init_from_env,
+        )
+
+        distributed_init_from_env()
+    fctx = fleet.initialize_from_env()
+    is_fleet = fctx is not None and fctx.is_fleet
+    host = fctx.host_id if is_fleet else 0
+    mesh_dir = os.path.join(args.cache_dir, "mesh_stream")
+    out_dir = fleet.host_dir(mesh_dir, fctx)
+    os.makedirs(out_dir, exist_ok=True)
+
+    n = args.n
+    ds = _make_cd_fused_workload(n, args.d, args.k)
+    chunk_rows = -(-n // MESH_CHUNKS)
+    cfg = TrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinates=[
+            CoordinateConfig(
+                name="global", kind=CoordinateKind.FIXED_EFFECT,
+                feature_shard="fe",
+                optimizer=OptimizerSettings(
+                    max_iters=CDF_LEGACY_MAX_ITERS, reg_weight=1.0)),
+            CoordinateConfig(
+                name="per_u", kind=CoordinateKind.RANDOM_EFFECT,
+                feature_shard="re", entity_key="u",
+                optimizer=OptimizerSettings(
+                    max_iters=CDF_LEGACY_MAX_ITERS, reg_weight=2.0)),
+        ],
+        update_sequence=["global", "per_u"], n_iterations=MESH_CYCLES,
+        validation_fraction=0.0, validate_per_iteration=False,
+        intercept=False, chunk_rows=chunk_rows, chunk_layout="ELL",
+        cd_fused=True,
+        # Shared base on purpose: the chunk builder host-shards it
+        # (``fleet.host_dir``) exactly as a production config would.
+        spill_dir=os.path.join(mesh_dir, "spill"),
+        host_max_resident=MESH_WINDOW, prefetch_depth=MESH_DEPTH)
+    cfg.validate()
+
+    run_info = {"telemetry": "metrics"}
+    if is_fleet:
+        run_info.update(fleet_host=fctx.host_id,
+                        fleet_hosts=fctx.n_hosts,
+                        fleet_transport=fctx.transport)
+    run_log_path = os.path.join(out_dir, "run_log.jsonl")
+    rl = RunLogger(run_log_path, run_info=run_info)
+    tel = telemetry.start("metrics", run_logger=rl)
+    t0 = time.time()
+    fit = GameEstimator(cfg).fit(ds)[0]
+    fit_s = time.time() - t0
+    tel_summary = tel.summary()
+    tel.close()
+    rl.close()
+
+    c = tel_summary.get("counters", {})
+    sweeps = c.get("solver.sweeps", 0)
+    cycles = c.get("cd.cycles", 0)
+    pass_total_s = tel_summary.get("derived", {}).get(
+        "pass_span_total_s") or None
+    models = fit.model.models
+    tag = f"h{host}" if is_fleet else "solo"
+    np.save(os.path.join(mesh_dir, f"mesh_fe_{tag}.npy"),
+            np.asarray(models["global"].coefficients.means))
+    np.save(os.path.join(mesh_dir, f"mesh_re_{tag}.npy"),
+            np.concatenate([np.asarray(b).ravel()
+                            for b in models["per_u"].coefficient_blocks]))
+    rec = {
+        "host": host,
+        "transport": fctx.transport if is_fleet else None,
+        "fit_s": round(fit_s, 2),
+        "cycles": cycles,
+        "data_passes": sweeps,
+        "passes_per_cycle": (round(sweeps / cycles, 3) if cycles
+                             else None),
+        "pass_span_total_s": pass_total_s,
+        "chunks_streamed": c.get("fleet.chunks_streamed", 0),
+        "reduces": c.get("fleet.psums", 0),
+        "barrier_wait_s": round(c.get("fleet.barrier_wait_s", 0.0), 3),
+        "chunk_rows": chunk_rows,
+        "n_chunks": MESH_CHUNKS,
+        "peak_rss_mb": round(_peak_rss_mb(), 1),
+        "run_log": run_log_path,
+        "telemetry": _telemetry_block(tel_summary),
+    }
+    print(json.dumps(rec))
+    return 0
+
+
+def section_mesh_stream(ctx: BenchContext) -> None:
+    """Multi-host out-of-core training (ISSUE 16 tentpole
+    measurement): MESH_HOSTS worker processes train the SAME fused-CD
+    workload as one chunk-synchronized fleet — each host spills +
+    streams only its shard of the MESH_CHUNKS grid and the per-chunk
+    partials cross hosts once per chunk step.  Transport is probed:
+    real ``jax.distributed`` psum where this box supports multi-process
+    CPU collectives, the local-fleet tcp coordinator otherwise (the
+    same solver/schedule code either way).  Claims under test: every
+    host reports the SAME reduce count (the sentinel-padded schedule's
+    no-deadlock invariant), the replicated solver odometer agrees
+    host-to-host with passes/cycle ≈ 1, final coefficients are bitwise
+    identical across hosts, per-host peak RSS is bounded by
+    shard+window (not the full grid), and the barrier-wait fraction
+    stays a small tax.  The per-host run logs are joined by the SAME
+    ``telemetry fleet-report`` analyzer an operator would use."""
+    import shutil
+    import subprocess
+
+    from photon_ml_tpu.parallel import fleet
+    from photon_ml_tpu.telemetry import fleet_report
+
+    mesh_dir = os.path.join(ctx.cache_dir, "mesh_stream")
+    shutil.rmtree(mesh_dir, ignore_errors=True)  # honest cold spill ETL
+    os.makedirs(mesh_dir, exist_ok=True)
+
+    use_psum = fleet.probe_cpu_multiprocess_collectives()
+    coord = None
+    envs = []
+    if use_psum:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        envs = [{"JAX_COORDINATOR_ADDRESS": f"localhost:{port}",
+                 "JAX_NUM_PROCESSES": str(MESH_HOSTS),
+                 "JAX_PROCESS_ID": str(h)} for h in range(MESH_HOSTS)]
+    else:
+        print("mesh_stream: multi-process CPU collectives unsupported "
+              "here; using the local-fleet tcp transport",
+              file=sys.stderr)
+        coord = fleet.ReduceCoordinator(MESH_HOSTS)
+        envs = [{"PHOTON_FLEET_NUM_HOSTS": str(MESH_HOSTS),
+                 "PHOTON_FLEET_HOST_ID": str(h),
+                 "PHOTON_FLEET_COORDINATOR": coord.address}
+                for h in range(MESH_HOSTS)]
+
+    def spawn(extra_env):
+        env = dict(os.environ)
+        env.update(extra_env)
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--mesh-arm", "fleet", "--n", str(ctx.n), "--d",
+             str(ctx.d), "--k", str(ctx.k),
+             "--cache-dir", ctx.cache_dir]
+            + (["--no-compile-cache"] if ctx.no_compile_cache else []),
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+
+    # All hosts MUST run concurrently (they barrier at every chunk
+    # step); the fleet wall-clock is the slowest host's, measured by
+    # the parent around the whole fan-out.
+    t0 = time.time()
+    procs = [spawn(e) for e in envs]
+    recs = []
+    try:
+        for h, proc in enumerate(procs):
+            out, err = proc.communicate(
+                timeout=max(120.0, ctx.remaining()))
+            sys.stderr.write(err)
+            if proc.returncode != 0:
+                raise RuntimeError(f"mesh host {h} failed "
+                                   f"(rc={proc.returncode}): "
+                                   f"{err[-500:]}")
+            recs.append(json.loads(
+                [ln for ln in out.splitlines() if ln.strip()][-1]))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        if coord is not None:
+            coord.close()
+    fleet_wall_s = time.time() - t0
+
+    fe = [np.load(os.path.join(mesh_dir, f"mesh_fe_h{h}.npy"))
+          for h in range(MESH_HOSTS)]
+    re_ = [np.load(os.path.join(mesh_dir, f"mesh_re_h{h}.npy"))
+           for h in range(MESH_HOSTS)]
+    coef_cross = float(max(
+        max(np.max(np.abs(fe[0] - fe[h]))
+            for h in range(1, MESH_HOSTS)),
+        max(np.max(np.abs(re_[0] - re_[h]))
+            for h in range(1, MESH_HOSTS))))
+
+    # The operator-facing join over the per-host logs IS the section's
+    # analysis layer — the bench exercises it instead of reimplementing
+    # the invariants.
+    fr = fleet_report.analyze(
+        fleet_report.load_host_logs([r["run_log"] for r in recs]))
+
+    spans = [r["pass_span_total_s"] for r in recs]
+    span = max([s for s in spans if s], default=None)
+    sweeps = fr["fleet_sweeps"] or max(
+        (r["data_passes"] for r in recs), default=0)
+    ctx.record["mesh_stream"] = {
+        "hosts": MESH_HOSTS,
+        "transport": recs[0]["transport"],
+        "n_chunks": MESH_CHUNKS,
+        "chunks_per_host": -(-MESH_CHUNKS // MESH_HOSTS),
+        "host_max_resident": MESH_WINDOW,
+        "prefetch_depth": MESH_DEPTH,
+        "cycles": MESH_CYCLES,
+        "fleet_wall_s": round(fleet_wall_s, 1),
+        # Fleet throughput: each chunk-synchronized sweep covers the
+        # full n rows ACROSS hosts, paced by the slowest host's
+        # in-pass time.
+        "rows_per_sec": (round(ctx.n * sweeps / span, 1)
+                         if span and sweeps else None),
+        "passes_per_cycle": fr["passes_per_cycle"],
+        "barrier_wait_fraction": fr["max_barrier_wait_fraction"],
+        "max_host_peak_rss_mb": (fr["max_peak_rss_mb"]
+                                 or max(r["peak_rss_mb"]
+                                        for r in recs)),
+        "reduces_per_host": fr["reduces"],
+        "total_chunks_streamed": fr["total_chunks_streamed"],
+        "barrier_agreement": fr["barrier_agreement"],
+        "odometer_agreement": fr["odometer_agreement"],
+        "coef_cross_host_max": coef_cross,
+        "coef_identical_across_hosts": coef_cross == 0.0,
+        "fleet_report_ok": fr["ok"],
+        "per_host": recs,
+    }
+    s = ctx.record["mesh_stream"]
+    print(f"mesh_stream: {MESH_HOSTS} hosts ({s['transport']}), "
+          f"reduce counts {fr['reduce_counts']}, passes/cycle "
+          f"{s['passes_per_cycle']}, max barrier-wait fraction "
+          f"{s['barrier_wait_fraction']:.1%}, max host peak RSS "
+          f"{s['max_host_peak_rss_mb']} MB, {s['rows_per_sec']} rows/s "
+          f"fleet-wide, cross-host coef delta {coef_cross:.1e}, "
+          f"fleet-report {'PASS' if fr['ok'] else 'FAIL'}",
+          file=sys.stderr)
+
+
 class _ServeServer:
     """One subprocess-isolated model server for the serve section:
     spawn with a config dict, poll ready, post, stop.  Two of these
@@ -2383,6 +2664,7 @@ SECTION_FNS = {
     "re": section_re,
     "cd_fused": section_cd_fused,
     "serve": section_serve,
+    "mesh_stream": section_mesh_stream,
 }
 
 
@@ -2499,6 +2781,12 @@ def main(argv: list[str] | None = None) -> int:
                    default=None,
                    help="internal: run ONE arm of the re section "
                         "in this process (per-arm peak-RSS isolation)")
+    p.add_argument("--mesh-arm", choices=("fleet", "solo"),
+                   default=None,
+                   help="internal: run ONE host of the mesh_stream "
+                        "section in this process (fleet identity comes "
+                        "from the environment; without fleet env vars "
+                        "this is a single-host control run)")
     args = p.parse_args(argv)
     if args.cache_dir is None:
         # Per-user default: a fixed shared-/tmp path would let another
@@ -2527,6 +2815,8 @@ def main(argv: list[str] | None = None) -> int:
         return re_arm_main(args)
     if args.cd_fused_arm:
         return cd_fused_arm_main(args)
+    if args.mesh_arm:
+        return mesh_arm_main(args)
 
     import jax
 

@@ -114,6 +114,12 @@ RULES = {
         "thread-spawning class — a dead peer pins the thread forever; "
         "bound it or waive with the termination argument"
     ),
+    "collective-in-host-branch": (
+        "psum/all_gather/... lexically inside a branch conditioned on "
+        "the process identity (process_index()/host_id) — hosts that "
+        "skip the branch never reach the collective and the fleet "
+        "deadlocks at the barrier"
+    ),
     "slow-unmarked": (
         "test measured slower than the threshold lacks "
         "@pytest.mark.slow"
@@ -1036,6 +1042,68 @@ def check_eternal_wait(ctx: _FileContext):
 
 
 # ---------------------------------------------------------------------------
+# Rule: collective-in-host-branch
+# ---------------------------------------------------------------------------
+
+# Cross-device/cross-host collectives: every participant must reach the
+# call or the fleet deadlocks at the barrier.
+_COLLECTIVE_FNS = ("psum", "psum_scatter", "pmean", "pmax", "pmin",
+                   "all_gather", "all_to_all", "ppermute", "pshuffle")
+
+
+def _divergent_host_test(test: ast.AST) -> bool:
+    """Does a branch condition read the PROCESS IDENTITY — a value that
+    differs per host, so the branch arms diverge across the fleet?
+    ``process_index()`` calls and ``host_id`` reads (the FleetContext
+    field) qualify; ``process_count()`` does not — it is uniform."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            d = _dotted(n.func)
+            if d and d.split(".")[-1] == "process_index":
+                return True
+        elif isinstance(n, ast.Attribute) and n.attr == "host_id":
+            return True
+        elif (isinstance(n, ast.Name) and n.id == "host_id"
+              and isinstance(n.ctx, ast.Load)):
+            return True
+    return False
+
+
+def check_collective_in_host_branch(ctx: _FileContext):
+    """A collective (psum/all_gather/...) lexically inside a branch
+    conditioned on the process identity (``jax.process_index()`` /
+    ``host_id``) is a fleet deadlock: only SOME hosts reach the
+    barrier, the rest wait forever (ISSUE 16 — the sharded streaming
+    tier pads ragged shards with empty-chunk sentinels precisely so
+    every host runs the same collective count).  Hoist the collective
+    out of the branch, make the condition uniform across hosts, or
+    waive with the reason every host still participates."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if not d or d.split(".")[-1] not in _COLLECTIVE_FNS:
+            continue
+        for anc in _ancestors(node, ctx.parents):
+            # A def boundary ends the lexical branch: a helper merely
+            # DEFINED under a host-conditional may be called by every
+            # host (lambdas stay transparent — jax collectives live in
+            # lambdas invoked in place).
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            if (isinstance(anc, (ast.If, ast.While, ast.IfExp))
+                    and _divergent_host_test(anc.test)):
+                yield Violation(
+                    ctx.path, node.lineno, "collective-in-host-branch",
+                    f"{d.split('.')[-1]} inside a branch on the process "
+                    "identity (process_index()/host_id, line "
+                    f"{anc.lineno}): hosts that skip the branch never "
+                    "reach the collective and the fleet deadlocks — "
+                    "hoist it out or make the condition uniform")
+                break
+
+
+# ---------------------------------------------------------------------------
 # Rule: slow-unmarked (repo-level: needs the recorded durations)
 # ---------------------------------------------------------------------------
 
@@ -1126,6 +1194,7 @@ _FILE_CHECKERS = (
     check_metric_name,
     check_swallowed_exception,
     check_eternal_wait,
+    check_collective_in_host_branch,
 )
 
 

@@ -177,6 +177,18 @@ def run(config: TrainingConfig, log: RunLogger | None = None) -> dict:
     enable_compilation_cache(config.compilation_cache_dir)
     if config.distributed_init:
         distributed_init_from_env()
+    # Multi-host streaming (ISSUE 16): join the fleet if this process
+    # was launched as one host of a sharded-streaming run (initialized
+    # jax.distributed runtime → psum transport; PHOTON_FLEET_* env trio
+    # → local tcp transport).  Each host then writes its OWN output
+    # tree (run_log, summary, models, telemetry) under a host_NNN/
+    # subdir — `telemetry fleet-report` joins the per-host logs into
+    # the aggregated fleet view.
+    from photon_ml_tpu.parallel import fleet
+
+    fctx = fleet.initialize_from_env()
+    if fctx is not None and fctx.is_fleet:
+        config.output_dir = fleet.host_dir(config.output_dir, fctx)
     os.makedirs(config.output_dir, exist_ok=True)
     from photon_ml_tpu import telemetry
     from photon_ml_tpu.telemetry import monitor as _mon
@@ -199,7 +211,12 @@ def run(config: TrainingConfig, log: RunLogger | None = None) -> dict:
                            header=True,
                            run_info={"driver": "game_training",
                                      "telemetry": config.telemetry,
-                                     "resume": config.resume},
+                                     "resume": config.resume,
+                                     **({"fleet_host": fctx.host_id,
+                                         "fleet_hosts": fctx.n_hosts,
+                                         "fleet_transport": fctx.transport}
+                                        if fctx is not None
+                                        and fctx.is_fleet else {})},
                            flush_every_s=DEFAULT_FLUSH_EVERY_S)
           ) as log, \
             telemetry.maybe_session(

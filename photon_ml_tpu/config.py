@@ -54,6 +54,12 @@ SANCTIONED_ENV = {
         "jax.distributed coordinator (multi-host init, training driver)"),
     "JAX_NUM_PROCESSES": "jax.distributed process count",
     "JAX_PROCESS_ID": "jax.distributed process id",
+    "PHOTON_FLEET_NUM_HOSTS": (
+        "local-fleet host count (parallel.fleet tcp transport — the "
+        "fallback when jaxlib has no multiprocess CPU collectives)"),
+    "PHOTON_FLEET_HOST_ID": "local-fleet host id (parallel.fleet)",
+    "PHOTON_FLEET_COORDINATOR": (
+        "local-fleet reduce coordinator host:port (parallel.fleet)"),
 }
 
 

@@ -95,10 +95,31 @@ class ChunkedBatch:
     # [n_chunks·chunk_rows] — CD-iteration state kept out of the
     # spilled payload so chunk files survive ``set_offsets``.
     offsets_host: np.ndarray | None = None
+    # Fleet mode (parallel.fleet): the contiguous chunk shard THIS host
+    # owns, and its sentinel-padded chunk-synchronized schedule (same
+    # length on every host).  None = single-host run, every chunk.
+    local_chunk_ids: list | None = None
+    schedule: list | None = None
 
     @property
     def n_chunks(self) -> int:
         return len(self.chunks)
+
+    @property
+    def owned_chunk_ids(self) -> list:
+        """Chunk ids this host streams (all of them outside a fleet)."""
+        if self.local_chunk_ids is None:
+            return list(range(self.n_chunks))
+        return list(self.local_chunk_ids)
+
+    @property
+    def chunk_schedule(self) -> list:
+        """The per-host chunk visit order: owned chunks first, then
+        ``fleet.EMPTY_CHUNK`` sentinels padding ragged shards to the
+        fleet-common step count (single-host: just every chunk)."""
+        if self.schedule is None:
+            return list(range(self.n_chunks))
+        return list(self.schedule)
 
     def chunk_slice(self, i: int) -> tuple[int, int]:
         """Real-example range [lo, hi) covered by chunk i."""
@@ -231,7 +252,17 @@ def build_chunked_batch(
     chunk_rows = -(-chunk_rows // n_dev) * n_dev
     n_chunks = -(-n // chunk_rows)
     per = chunk_rows // n_dev
-    n_pieces = n_chunks * n_dev
+
+    # Fleet mode: this host builds/spills/streams ONLY its contiguous
+    # chunk shard; ids stay global (the full grid is the coordinate
+    # system for offsets and checkpoints).
+    from photon_ml_tpu.parallel import fleet as _fleet
+
+    fctx = _fleet.active()
+    local_ids = schedule = None
+    if fctx is not None and fctx.is_fleet:
+        local_ids, schedule = _fleet.shard_chunk_ids(
+            n_chunks, fctx.host_id, fctx.n_hosts)
 
     weights = np.ones(n, np.float32) if weights is None else np.asarray(
         weights, np.float32)
@@ -277,9 +308,16 @@ def build_chunked_batch(
         return [pieces[i * n_dev:(i + 1) * n_dev]
                 for i in range(len(pieces) // n_dev)]
 
-    def compile_all(zero_offsets=False):
-        pieces_arr = [piece_arrays(p) for p in range(n_pieces)]
-        grr_pairs = [None] * n_pieces
+    def compile_all(zero_offsets=False, chunk_ids=None):
+        """Build the given chunks (default: all) → {chunk_id: chunk}.
+        A fleet host passes its shard — GRR hot/mid congruence is then
+        per-host, which is sound (the plan layout is a per-chunk
+        program detail; only the dim-indexed coefficients are global)
+        and keeps ETL cost proportional to the shard."""
+        ids = list(range(n_chunks)) if chunk_ids is None else list(chunk_ids)
+        ps = [p for i in ids for p in range(i * n_dev, (i + 1) * n_dev)]
+        pieces_arr = [piece_arrays(p) for p in ps]
+        grr_pairs = [None] * len(ps)
         if layout == "grr":
             from photon_ml_tpu.data.grr import build_sharded_grr_pairs
 
@@ -289,9 +327,14 @@ def build_chunked_batch(
                 dim,
                 cache_dir=cache_dir,
             )
-        return group(make_pieces(pieces_arr, grr_pairs, zero_offsets))
+        return dict(zip(ids, group(make_pieces(pieces_arr, grr_pairs,
+                                               zero_offsets))))
 
     if spill_dir is not None:
+        # Per-host spill subdir: fleet hosts never share chunk files
+        # (each opens/spills only its shard, and two hosts on one
+        # machine must not race the same window accounting).
+        spill_dir = _fleet.host_dir(spill_dir, fctx)
         # Unwritable spill dir DEGRADES to the resident build with one
         # warning (ISSUE 9): losing the disk tier costs memory bound,
         # not the run.
@@ -304,14 +347,18 @@ def build_chunked_batch(
         # per-shard sub-plan's spill note folds into ONE summary line
         # (ISSUE 4 satellite — MULTICHIP_r05's tail was 15+ lines).
         with collect_spill_warnings():
-            chunks = compile_all()
+            built = compile_all(chunk_ids=local_ids)
+        chunks = [built.get(i) for i in range(n_chunks)]
         logger.info(
-            "chunked batch: n=%d -> %d chunks x %d rows (%s%s)", n,
+            "chunked batch: n=%d -> %d chunks x %d rows (%s%s)%s", n,
             n_chunks, chunk_rows, layout,
-            f", {n_dev}-device mesh" if mesh else "")
+            f", {n_dev}-device mesh" if mesh else "",
+            (f", host {fctx.host_id}/{fctx.n_hosts} shard "
+             f"{len(built)} chunks") if local_ids is not None else "")
         return ChunkedBatch(chunks=chunks, dim=dim, n=n,
                             chunk_rows=chunk_rows, layout=layout,
-                            mesh=mesh)
+                            mesh=mesh, local_chunk_ids=local_ids,
+                            schedule=schedule)
 
     # -- spilled build: disk tier on, host RSS bounded by the window --
     from photon_ml_tpu.data.chunk_store import ChunkStore, store_key
@@ -333,20 +380,21 @@ def build_chunked_batch(
         if layout == "ell":
             return build_chunk_ell(i)
         # GRR congruence (shared hot/mid sets, pooled overflow, common
-        # padding) is a GLOBAL property: rebuilding one chunk means
-        # rebuilding the plan set (the plan cache makes this one load
-        # when cache_dir is set).  Heal every missing sibling while the
-        # set is in hand.
-        chunks_all = compile_all(zero_offsets=True)
-        for j, ch in enumerate(chunks_all):
+        # padding) is a GLOBAL property of this host's plan set:
+        # rebuilding one chunk means rebuilding the set (the plan cache
+        # makes this one load when cache_dir is set).  Heal every
+        # missing sibling while the set is in hand.
+        built = compile_all(zero_offsets=True, chunk_ids=local_ids)
+        for j, ch in built.items():
             if j != i and not store.has(j):
                 store.put(j, ch, keep_resident=False)
-        return chunks_all[i]
+        return built[i]
 
     store = ChunkStore(spill_dir, key, n_chunks,
                        host_max_resident=host_max_resident,
                        rebuild=rebuild)
-    missing = [i for i in range(n_chunks) if not store.has(i)]
+    owned = list(range(n_chunks)) if local_ids is None else local_ids
+    missing = [i for i in owned if not store.has(i)]
     with collect_spill_warnings():   # one summary per sharded build
         if missing and layout == "ell":
             # Build-time spill: one chunk in flight at a time — ETL
@@ -354,9 +402,9 @@ def build_chunked_batch(
             for i in missing:
                 store.put(i, build_chunk_ell(i))
         elif missing:
-            chunks_all = compile_all(zero_offsets=True)
+            built = compile_all(zero_offsets=True, chunk_ids=local_ids)
             for i in missing:
-                store.put(i, chunks_all[i])
+                store.put(i, built[i])
     if missing:
         from photon_ml_tpu.data.chunk_store import release_free_heap
 
@@ -365,10 +413,13 @@ def build_chunked_batch(
     offsets_host[:n] = offsets
     logger.info(
         "chunked batch: n=%d -> %d chunks x %d rows (%s%s), spilled to "
-        "%s (%d built, %d reused; host window %d)", n, n_chunks,
+        "%s (%d built, %d reused; host window %d)%s", n, n_chunks,
         chunk_rows, layout, f", {n_dev}-device mesh" if mesh else "",
-        spill_dir, len(missing), n_chunks - len(missing),
-        store.host_max_resident)
+        spill_dir, len(missing), len(owned) - len(missing),
+        store.host_max_resident,
+        (f", host {fctx.host_id}/{fctx.n_hosts} shard "
+         f"{len(owned)} chunks") if local_ids is not None else "")
     return ChunkedBatch(chunks=[None] * n_chunks, dim=dim, n=n,
                         chunk_rows=chunk_rows, layout=layout, mesh=mesh,
-                        store=store, offsets_host=offsets_host)
+                        store=store, offsets_host=offsets_host,
+                        local_chunk_ids=local_ids, schedule=schedule)

@@ -44,6 +44,7 @@ the XLA scatter, a ~100× speedup of the framework's hot loop.
 from __future__ import annotations
 
 import logging
+import time
 
 import jax
 import jax.numpy as jnp
@@ -85,10 +86,19 @@ class _SpillWarnings:
     signal).  Inside a collecting scope (entered by ``build_grr_pair``
     and ``build_sharded_grr_pairs``; re-entrant, thread-safe — the
     direction builds run in a thread pool) the per-build lines are
-    aggregated into ONE max/mean summary at scope exit; a direction
-    built outside any scope keeps the immediate warning."""
+    aggregated into ONE count/min/max/mean summary at scope exit.
+
+    Direction builds OUTSIDE any scope (the raw builder API — ISSUE 16
+    satellite: these used to print one raw line per call) aggregate
+    the same way into a time-windowed summary: the first flagged build
+    reports immediately, then further flagged builds buffer for
+    ``_UNSCOPED_WINDOW_S`` and the next note past the window emits ONE
+    summary for the whole burst.  Every emission also feeds the
+    ``grr.spill_flagged_builds`` telemetry counter so the report/bench
+    tiers see the signal without parsing log text."""
 
     _THRESHOLD = 0.05    # COO fraction below which no one needs to act
+    _UNSCOPED_WINDOW_S = 30.0   # unscoped-burst dedupe window
 
     def __init__(self):
         import threading
@@ -97,10 +107,20 @@ class _SpillWarnings:
         self._depth = 0
         self._builds = 0
         self._flagged: list = []   # fractions over threshold
+        self._last_emit: float | None = None
 
     def __enter__(self):
         with self._lock:
+            if self._depth == 0:
+                # Flush (or, when nothing was flagged, discard) any
+                # buffered unscoped builds first, so the scope's own
+                # summary counts only its builds.
+                builds, flagged = self._drain()
+            else:
+                builds = flagged = None
             self._depth += 1
+        if flagged:
+            self._emit(builds, flagged)
         return self
 
     def __exit__(self, *exc):
@@ -108,32 +128,49 @@ class _SpillWarnings:
             self._depth -= 1
             if self._depth:
                 return False
-            builds, flagged = self._builds, self._flagged
-            self._builds, self._flagged = 0, []
+            builds, flagged = self._drain()
         if flagged:
-            logger.warning(
-                "GRR spill fraction >%.0f%% on the XLA fallback in %d "
-                "of %d direction builds (max %.1f%%, mean %.1f%%) — "
-                "consider a larger cap or a lower hot-column threshold",
-                100 * self._THRESHOLD, len(flagged), builds,
-                100 * max(flagged), 100 * sum(flagged) / len(flagged))
+            self._emit(builds, flagged)
         return False
+
+    def _drain(self) -> tuple[int, list]:
+        """Take + reset the buffered stats (caller holds the lock)."""
+        builds, flagged = self._builds, self._flagged
+        self._builds, self._flagged = 0, []
+        return builds, flagged
+
+    def _emit(self, builds: int, flagged: list) -> None:
+        from photon_ml_tpu import telemetry
+
+        telemetry.count("grr.spill_flagged_builds", len(flagged))
+        logger.warning(
+            "GRR spill fraction >%.0f%% on the XLA fallback in %d "
+            "of %d direction builds (min %.1f%%, max %.1f%%, mean "
+            "%.1f%%) — consider a larger cap or a lower hot-column "
+            "threshold",
+            100 * self._THRESHOLD, len(flagged), builds,
+            100 * min(flagged), 100 * max(flagged),
+            100 * sum(flagged) / len(flagged))
 
     def note(self, m_coo: int, total: int) -> None:
         if not total:
             return
         frac = m_coo / total
         with self._lock:
+            self._builds += 1
+            if frac > self._THRESHOLD:
+                self._flagged.append(frac)
             if self._depth:
-                self._builds += 1
-                if frac > self._THRESHOLD:
-                    self._flagged.append(frac)
                 return
-        if frac > self._THRESHOLD:
-            logger.warning(
-                "GRR spill fraction %.1f%% (%d of %d) on the XLA "
-                "fallback — consider a larger cap or a lower "
-                "hot-column threshold", 100 * frac, m_coo, total)
+            if not self._flagged:
+                return
+            now = time.monotonic()
+            if (self._last_emit is not None
+                    and now - self._last_emit < self._UNSCOPED_WINDOW_S):
+                return               # buffer the burst
+            self._last_emit = now
+            builds, flagged = self._drain()
+        self._emit(builds, flagged)
 
 
 _spill_warnings = _SpillWarnings()

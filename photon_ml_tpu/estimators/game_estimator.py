@@ -946,11 +946,19 @@ class GameEstimator:
 
     def _checkpointer(self, ckpt_dir: str | None, run_logger):
         """Config-cadenced ``reliability.checkpoint.RunCheckpointer``
-        for ``ckpt_dir`` (None when checkpointing is off)."""
+        for ``ckpt_dir`` (None when checkpointing is off).
+
+        Under an active fleet context the directory is sharded per
+        host (``host_NNN/`` subdir): every host snapshots its own
+        replicated solver state plus its private fleet reduce
+        sequence, so a killed host resumes from its OWN manifest
+        without restarting — or reading the state of — its peers."""
         if not ckpt_dir:
             return None
+        from photon_ml_tpu.parallel import fleet
         from photon_ml_tpu.reliability.checkpoint import RunCheckpointer
 
+        ckpt_dir = fleet.host_dir(ckpt_dir, fleet.active())
         cfg = self.config
         return RunCheckpointer(
             ckpt_dir, every_sweeps=cfg.checkpoint_every_sweeps,

@@ -284,6 +284,12 @@ def run_coordinate_descent(
             start_pos = loaded["coord_pos"]
             initial_coefficients.update(loaded["coefs"])
             restored_extra = loaded["extra"]
+            # Fleet resume: restore the reduce counter recorded at this
+            # checkpoint boundary so the host replays its reduce
+            # sequence (cache-answered) back to the live barrier.
+            from photon_ml_tpu.optim.streaming import _restore_fleet_seq
+
+            _restore_fleet_seq(restored_extra.get("fleet_seq"))
             # Fused-cycle engine state rides re_state under a reserved
             # key (ISSUE 11); it is restored by the fused branch below
             # and the per-coordinate loop skips it (no such coordinate).
@@ -394,10 +400,13 @@ def run_coordinate_descent(
                 and name not in locked_coordinates}
 
     def _extra() -> dict:
+        from photon_ml_tpu.optim.streaming import _fleet_seq
+
         return {"history": _serialize_history(history),
                 "validation_history": _serialize_validation(
                     validation_history),
-                "prev_values": dict(prev_values)}
+                "prev_values": dict(prev_values),
+                "fleet_seq": _fleet_seq()}
 
     # A mid-sweep resume re-enters a PARTIAL sweep: the coordinates it
     # skips already trained before the kill, and their diagnostics ride
@@ -467,9 +476,12 @@ def _run_fused_cycles(engine, coordinates, update_sequence,
         restored_extra.get("validation_history"))
 
     def _extra() -> dict:
+        from photon_ml_tpu.optim.streaming import _fleet_seq
+
         return {"history": _serialize_history(history),
                 "validation_history": _serialize_validation(
-                    validation_history)}
+                    validation_history),
+                "fleet_seq": _fleet_seq()}
 
     scores: dict = {}
     total = None

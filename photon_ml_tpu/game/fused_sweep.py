@@ -292,18 +292,40 @@ class FusedCycleEngine:
         return self._sidecar_resident[i]
 
     def _stream(self):
-        """(i, device (batch, sidecar)) pairs in chunk order, through
-        the round-8 prefetch pipeline when the FE chunks are
-        store-backed."""
+        """(i, device (batch, sidecar)) pairs in this host's schedule
+        order, through the round-8 prefetch pipeline when the FE chunks
+        are store-backed.  Fleet sentinels (``EMPTY_CHUNK`` — ragged
+        shards padded to the fleet-common step count) yield
+        ``(id, None)`` and stream nothing."""
         from photon_ml_tpu.optim.streaming import prefetch_stream
 
+        sched = self.chunked.chunk_schedule
+        real = [i for i in sched if i >= 0]
         load = lambda i: (self.chunked.chunk(i), self._sidecar(i))
-        return prefetch_stream(load, jax.device_put,
-                               range(self.chunked.n_chunks),
-                               self.prefetch_depth,
-                               store=self.chunked.store)
+        inner = prefetch_stream(load, jax.device_put, real,
+                                self.prefetch_depth,
+                                store=self.chunked.store)
+        try:
+            for i in sched:
+                yield (i, None) if i < 0 else next(inner)
+        finally:
+            inner.close()   # quiesce the prefetcher on early exit too
 
     # -- the pass ------------------------------------------------------------
+
+    def _zero_stats(self):
+        """The sentinel chunk's statistics partial — exact zeros in the
+        5-tuple shape ``_fused_chunk`` accumulates, so a ragged-shard
+        host contributes nothing to the fleet reduction while still
+        taking every chunk barrier."""
+        d = self.chunked.dim
+        return (jnp.zeros((), jnp.float32),
+                jnp.zeros((d,), jnp.float32),
+                jnp.zeros((d,), jnp.float32),
+                tuple(jnp.zeros((r.E_total + 1, r.p_max), jnp.float32)
+                      for r in self.res),
+                tuple(jnp.zeros((r.E_total + 1, r.p_max, r.p_max),
+                                jnp.float32) for r in self.res))
 
     def _pass(self, w_fe: Array, tabs: list[Array],
               actives: list[Array]):
@@ -311,12 +333,23 @@ class FusedCycleEngine:
         score planes at the INPUT coefficients.  Backpressure: chunk
         i−1's accumulate fences before chunk i dispatches (the round-8
         rule), and per-example planes D2H-copy asynchronously under
-        later chunks' compute."""
+        later chunks' compute.
+
+        Fleet runs reduce the 5-tuple statistics across hosts at EVERY
+        schedule step (the chunk barrier — each host contributed a
+        different chunk, or zeros past its ragged shard) and the score
+        planes ONCE at the end, so all hosts return identical global
+        statistics and full [n] planes: the Jacobi solves and the
+        retirement bookkeeping above stay fleet-oblivious and
+        replicated."""
+        from photon_ml_tpu.parallel import fleet as _fleet
+
         K = self.chunked.n_chunks
-        R = self.chunked.chunk_rows
         names = [r.name for r in self.res]
+        fred = _fleet.reducer()
         acc = None
-        per_ex: list = []          # (fe_scores, re_scores) per chunk
+        per_ex: list = []       # (chunk id, (fe_plane, re_planes))
+        steps = len(self.chunked.chunk_schedule)
         sidecar_store = self._sidecar_store
         if sidecar_store is not None:
             sidecar_store.begin_read()
@@ -324,7 +357,17 @@ class FusedCycleEngine:
             with telemetry.span("fused_cycle_pass", cat="solver",
                                 chunks=K):
                 telemetry.count("solver.sweeps")
-                for i, placed in self._stream():
+                for si, (i, placed) in enumerate(self._stream()):
+                    if i < 0:
+                        stats = self._zero_stats()
+                        if fred is not None:
+                            stats = fred.reduce(stats)
+                        acc = (stats if acc is None
+                               else _acc_add(acc, stats))
+                        _mon.progress("train.cd_fused", si + 1, steps,
+                                      unit="chunks",
+                                      cycle=self.cycles + 1)
+                        continue
                     batch, sc = placed
                     re_xs = tuple(sc[n + ".x"] for n in names)
                     re_idxs = tuple(sc[n + ".idx"] for n in names)
@@ -340,23 +383,32 @@ class FusedCycleEngine:
                             pl.copy_to_host_async()
                         except AttributeError:  # photon-lint: disable=swallowed-exception (backends without async D2H; device_get below copies synchronously)
                             pass
-                    per_ex.append(planes)
+                    per_ex.append((i, planes))
+                    if fred is not None:
+                        stats = fred.reduce(stats)
+                        telemetry.count("fleet.chunks_streamed")
                     acc = stats if acc is None else _acc_add(acc, stats)
                     # Live fused-cycle progress (ISSUE 11 satellite):
                     # chunks done/total drives watch/ETA exactly like
                     # every other instrumented loop.
-                    _mon.progress("train.cd_fused", i + 1, K,
+                    _mon.progress("train.cd_fused", si + 1, steps,
                                   unit="chunks", cycle=self.cycles + 1)
         finally:
             if sidecar_store is not None:
                 sidecar_store.end_read()
         fe_scores = np.zeros(self.n, np.float32)
         re_scores = [np.zeros(self.n, np.float32) for _ in self.res]
-        for i, (fe_pl, re_pls) in enumerate(per_ex):
+        for i, (fe_pl, re_pls) in per_ex:
             lo, hi = self.chunked.chunk_slice(i)
             fe_scores[lo:hi] = jax.device_get(fe_pl)[: hi - lo]
             for j, pl in enumerate(re_pls):
                 re_scores[j][lo:hi] = jax.device_get(pl)[: hi - lo]
+        if fred is not None:
+            # One barrier for ALL score planes: examples are disjoint
+            # across hosts, so the sum is the concatenation.
+            fe_scores, re_scores = fred.reduce((fe_scores, re_scores))
+            fe_scores = np.asarray(fe_scores)
+            re_scores = [np.asarray(s) for s in re_scores]
         return acc, fe_scores, re_scores
 
     # -- value bookkeeping ---------------------------------------------------
@@ -544,12 +596,18 @@ class FusedCycleEngine:
         coefficients: retirement masks, offset baselines, and the
         Jacobi step-scale — so a resumed run steps exactly as the
         uninterrupted run would have."""
+        from photon_ml_tpu.optim.streaming import _fleet_seq
+
         return {
             "fingerprint": self._identity_fingerprint(),
             "alpha": float(self.alpha),
             "prev_value": (None if self.prev_value is None
                            else float(self.prev_value)),
             "cycles": int(self.cycles),
+            # Fleet reduce counter at this cycle boundary: a killed
+            # host restores it and replays its reduce sequence through
+            # the coordinator's result cache (see parallel.fleet).
+            "fleet_seq": _fleet_seq(),
             "re": {r.name: {
                 "active": np.asarray(r.active),
                 "solved_off": (None if r.solved_off is None
@@ -572,10 +630,13 @@ class FusedCycleEngine:
                     "fused checkpoint was written under a different "
                     "configuration (regularization / tolerance / chunk "
                     "geometry changed); start a fresh checkpoint_dir")
+        from photon_ml_tpu.optim.streaming import _restore_fleet_seq
+
         self.alpha = float(state.get("alpha", 1.0))
         pv = state.get("prev_value")
         self.prev_value = None if pv is None else float(pv)
         self.cycles = int(state.get("cycles", 0))
+        _restore_fleet_seq(state.get("fleet_seq"))
         for r in self.res:
             st = (state.get("re") or {}).get(r.name)
             if st is None:
@@ -793,6 +854,13 @@ def build_fused_cycle_engine(
             out[name + ".idx"] = idx
         return out
 
+    # Fleet mode: sidecars (like the FE chunks) are built and spilled
+    # only for this host's shard, under its per-host spill subdir.
+    from photon_ml_tpu.parallel import fleet as _fleet
+
+    fctx = _fleet.active()
+    owned = chunked.owned_chunk_ids
+
     sidecar_store = None
     sidecar_resident = None
     if res and spill_dir is not None:
@@ -804,6 +872,7 @@ def build_fused_cycle_engine(
             release_free_heap,
         )
 
+        spill_dir = _fleet.host_dir(spill_dir, fctx)
         if probe_spill_dir(spill_dir) is not None:
             key_arrays = []
             for name in sorted(side_planes):
@@ -818,7 +887,7 @@ def build_fused_cycle_engine(
                 host_max_resident=host_max_resident,
                 rebuild=build_sidecar, codec=FUSED_CHUNK_CODEC,
                 window_group=window_group)
-            missing = [i for i in range(K) if not sidecar_store.has(i)]
+            missing = [i for i in owned if not sidecar_store.has(i)]
             for i in missing:
                 sidecar_store.put(i, build_sidecar(i))
             # Spilled: drop the materialized planes (see ``_planes``) —
@@ -828,10 +897,12 @@ def build_fused_cycle_engine(
                 release_free_heap()
             logger.info(
                 "fused sidecar: %d chunks (%d built, %d reused) "
-                "spilled to %s", K, len(missing), K - len(missing),
-                spill_dir)
+                "spilled to %s", len(owned), len(missing),
+                len(owned) - len(missing), spill_dir)
     if res and sidecar_store is None:
-        sidecar_resident = [build_sidecar(i) for i in range(K)]
+        owned_set = set(owned)
+        sidecar_resident = [build_sidecar(i) if i in owned_set else None
+                            for i in range(K)]
 
     engine = FusedCycleEngine(
         fe_name=fe_name, fe_coord=fe_coord, res=res, n_examples=n,

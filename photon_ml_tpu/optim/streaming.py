@@ -83,6 +83,15 @@ _CURVATURE_EPS = 1e-10
 DEFAULT_STALL_TIMEOUT_S = 600.0
 
 
+def _fleet_reducer():
+    """The active fleet's per-chunk allreduce, or None (single host).
+    Lazy import: ``parallel`` pulls mesh machinery this module only
+    needs when a mesh (or fleet) is actually in play."""
+    from photon_ml_tpu.parallel import fleet
+
+    return fleet.reducer()
+
+
 def _place_chunk(chunk, mesh):
     """Host chunk → device: plain device_put, or example-sharded
     assembly of the per-device sub-batches onto the mesh."""
@@ -512,11 +521,14 @@ class ChunkedGLMObjective:
         an active telemetry session or with an empty batch."""
         if telemetry.active() is None or self.batch.n_chunks == 0:
             return
+        owned = self.batch.owned_chunk_ids
+        if not owned:   # all-sentinel fleet host: nothing to capture
+            return
         store = self.batch.store
         if store is not None:
             store.begin_read()
         try:
-            b = _place_chunk(self.batch.chunk(0), self._mesh)
+            b = _place_chunk(self.batch.chunk(owned[0]), self._mesh)
         finally:
             if store is not None:
                 store.end_read()
@@ -545,33 +557,47 @@ class ChunkedGLMObjective:
         Spill-store batches run the three-tier prefetch thread (disk →
         host window → async device_put, ``prefetch_depth`` deep);
         resident batches keep the classic device double-buffer (the
-        transfer of chunk i+1 dispatches before chunk i's compute)."""
-        k = self.batch.n_chunks
-        if k == 0:
+        transfer of chunk i+1 dispatches before chunk i's compute).
+
+        Yields ``(chunk_id, device_chunk)`` in this host's schedule
+        order.  Fleet hosts visit only their shard; sentinel steps
+        (``fleet.EMPTY_CHUNK`` — ragged-shard padding so every host
+        takes the same number of chunk barriers) yield
+        ``(EMPTY_CHUNK, None)`` and stream nothing."""
+        sched = self.batch.chunk_schedule
+        real = [i for i in sched if i >= 0]
+        if not sched:
             return
-        if self.batch.store is not None and self.prefetch_depth > 0:
+        if self.batch.store is not None and self.prefetch_depth > 0 \
+                and real:
             pf = ChunkPrefetcher(
                 self.batch.chunk,
                 lambda host: _place_chunk(host, self._mesh),
                 self.prefetch_depth, store=self.batch.store)
             self._active_prefetcher = pf
-            pf.start(range(k))
+            pf.start(real)
             try:
-                for i in range(k):
-                    yield pf.next(i)
+                for i in sched:
+                    yield (i, pf.next(i)) if i >= 0 else (i, None)
             finally:
                 pf.close()
                 self._active_prefetcher = None
             return
-        nxt = self._get(0)
-        for i in range(k):
+        nxt = self._get(real[0]) if real else None
+        pos = 0
+        for i in sched:
+            if i < 0:
+                yield i, None
+                continue
             cur = nxt
-            if i + 1 < k:
-                nxt = self._get(i + 1)   # async transfer under compute
-            yield cur
+            pos += 1
+            if pos < len(real):
+                nxt = self._get(real[pos])  # async transfer under compute
+            yield i, cur
 
-    def _sweep(self, per_chunk, combine, cost=None):
-        """Stream all chunks through ``per_chunk``, pipelined.
+    def _sweep(self, per_chunk, combine, cost=None, zero=None):
+        """Stream this host's chunk schedule through ``per_chunk``,
+        pipelined.
 
         Out-of-core batches add BACKPRESSURE: chunk i-1's accumulate is
         fenced before chunk i dispatches, so the async dispatch queue
@@ -587,9 +613,24 @@ class ChunkedGLMObjective:
         capture spec (ISSUE 8) — resolved once per session per name on
         the FIRST chunk, right after its dispatch (the lowering cache is
         then warm, so the capture relowers without a new compile
-        record)."""
+        record).
+
+        ``zero``: the sentinel partial (``() → same pytree shape as
+        ``per_chunk``'s result, all zeros``).  Fleet runs REQUIRE it —
+        a host's sentinel steps and all-sentinel hosts contribute exact
+        zeros to the per-chunk fleet reduction, so ragged shards never
+        skew the barrier count.  Outside a fleet it is never called.
+
+        Fleet runs reduce each chunk partial across hosts (the
+        chunk-synchronized barrier) and every host accumulates the
+        SAME global totals — solver state stays replicated, so the
+        solvers above this line are fleet-oblivious."""
         self.sweeps += 1
         telemetry.count("solver.sweeps")
+        fred = _fleet_reducer()
+        if fred is not None and zero is None:
+            raise ValueError(
+                "fleet sweep needs a zero() sentinel template")
         bounded = self.batch.store is not None
         # Per-program dispatch times are only MEANINGFUL on the bounded
         # (spilled) path, where the backpressure fence makes each
@@ -600,28 +641,36 @@ class ChunkedGLMObjective:
         timed = (cost is not None and bounded
                  and telemetry.active() is not None)
         acc = None
+        steps = len(self.batch.chunk_schedule)
         with telemetry.span("sweep", cat="solver",
                             chunks=self.batch.n_chunks):
-            for ci, cur in enumerate(self._chunk_stream()):
+            for ci, (cid, cur) in enumerate(self._chunk_stream()):
                 # The span covers the backpressure fence too: that wait
                 # IS the previous chunk's device compute retiring.
                 t0 = time.perf_counter() if timed else None
                 with telemetry.span("chunk_compute", cat="device"):
                     if bounded and acc is not None:
                         jax.block_until_ready(acc)
-                    out = per_chunk(cur)
+                    out = per_chunk(cur) if cid >= 0 else zero()
                 # Live chunk progress (ISSUE 10): the monitor derives
                 # rolling chunk throughput + a within-sweep ETA; a
                 # no-op global read when monitoring is off, throttled
                 # to its wall-clock cadence when on.
-                _mon.progress("train.sweep", ci + 1,
-                              self.batch.n_chunks, unit="chunks")
+                _mon.progress("train.sweep", ci + 1, steps,
+                              unit="chunks")
                 newly_captured = False
-                if acc is None and cost is not None:
+                if acc is None and cost is not None and cid >= 0:
                     name, fn, mk_args = cost
                     newly_captured = _device.maybe_capture(
                         name, fn, mk_args(cur), span="chunk_compute")
-                if timed and not newly_captured:
+                if fred is not None:
+                    # Chunk barrier: this step's partial summed across
+                    # the fleet (each host contributed a DIFFERENT
+                    # chunk, or zeros past its ragged shard).
+                    out = fred.reduce(out)
+                    if cid >= 0:
+                        telemetry.count("fleet.chunks_streamed")
+                if timed and not newly_captured and cid >= 0:
                     # Per-PROGRAM dispatch histogram: the shared
                     # "chunk_compute" span pools every chunk program's
                     # dispatches, so the device report joins each
@@ -643,7 +692,8 @@ class ChunkedGLMObjective:
         val = self._sweep(lambda b: _jit_val(self._inner, w, b),
                           lambda a, x: a + x,
                           cost=("chunk_value", _jit_val,
-                                lambda b: (self._inner, w, b)))
+                                lambda b: (self._inner, w, b)),
+                          zero=lambda: jnp.zeros((), jnp.float32))
         val = val + self.objective.reg.l2_value(w)
         if self.objective.prior is not None:
             val = val + self.objective.prior.value(w)
@@ -654,7 +704,9 @@ class ChunkedGLMObjective:
         f, g = self._sweep(
             lambda b: _jit_vg(self._inner, w, b),
             lambda a, x: (a[0] + x[0], a[1] + x[1]),
-            cost=("chunk_vg", _jit_vg, lambda b: (self._inner, w, b)))
+            cost=("chunk_vg", _jit_vg, lambda b: (self._inner, w, b)),
+            zero=lambda: (jnp.zeros((), jnp.float32),
+                          jnp.zeros_like(w)))
         reg = self.objective.reg
         f = f + reg.l2_value(w)
         g = g + reg.l2_gradient(w)
@@ -673,7 +725,8 @@ class ChunkedGLMObjective:
         # sweep-odometer reconciliation accounts it separately.
         telemetry.count("solver.aux_sweeps")
         hv = self._sweep(lambda b: _jit_hvp(self._inner, w, v, b),
-                         lambda a, x: a + x)
+                         lambda a, x: a + x,
+                         zero=lambda: jnp.zeros_like(w))
         hv = hv + self.objective.reg.l2_hessian_vector(v)
         if self.objective.prior is not None:
             hv = hv + self.objective.prior.hessian_vector(v)
@@ -683,7 +736,8 @@ class ChunkedGLMObjective:
         w = jnp.asarray(w, jnp.float32)
         telemetry.count("solver.aux_sweeps")
         hd = self._sweep(lambda b: _jit_hd(self._inner, w, b),
-                         lambda a, x: a + x)
+                         lambda a, x: a + x,
+                         zero=lambda: jnp.zeros_like(w))
         hd = hd + self.objective.reg.l2_hessian_diagonal(w)
         if self.objective.prior is not None:
             hd = hd + self.objective.prior.hessian_diagonal()
@@ -713,7 +767,8 @@ class ChunkedGLMObjective:
             lambda b: _jit_val_swept(self._inner, W, b, self._lane_map),
             lambda a, x: a + x,
             cost=("chunk_value_swept", _jit_val_swept,
-                  lambda b: (self._inner, W, b, self._lane_map)))
+                  lambda b: (self._inner, W, b, self._lane_map)),
+            zero=lambda: jnp.zeros((W.shape[0],), jnp.float32))
         val = val + self._lane_reg(W, reg, "l2_value")
         if self.objective.prior is not None:
             val = val + jax.vmap(self.objective.prior.value)(W)
@@ -731,7 +786,9 @@ class ChunkedGLMObjective:
             lambda b: _jit_vg_swept(self._inner, W, b, self._lane_map),
             lambda a, x: (a[0] + x[0], a[1] + x[1]),
             cost=("chunk_vg_swept", _jit_vg_swept,
-                  lambda b: (self._inner, W, b, self._lane_map)))
+                  lambda b: (self._inner, W, b, self._lane_map)),
+            zero=lambda: (jnp.zeros((W.shape[0],), jnp.float32),
+                          jnp.zeros_like(W)))
         f = f + self._lane_reg(W, reg, "l2_value")
         g = g + self._lane_reg(W, reg, "l2_gradient")
         if self.objective.prior is not None:
@@ -753,10 +810,16 @@ class ChunkedGLMObjective:
         a full data pass like any other)."""
         pending = []
         bounded = self.batch.store is not None
+        fred = _fleet_reducer()
         telemetry.count("solver.per_example_passes")
+        steps = len(self.batch.chunk_schedule)
         with telemetry.span("per_example_pass", cat="solver",
                             chunks=self.batch.n_chunks):
-            for i, cur in enumerate(self._chunk_stream()):
+            for ci, (cid, cur) in enumerate(self._chunk_stream()):
+                if cid < 0:   # ragged-shard sentinel: nothing to score
+                    _mon.progress("train.pass", ci + 1, steps,
+                                  unit="chunks")
+                    continue
                 with telemetry.span("chunk_compute", cat="device"):
                     if bounded and pending:
                         # Backpressure (see _sweep): chunk i-1's compute
@@ -771,19 +834,30 @@ class ChunkedGLMObjective:
                     m.copy_to_host_async()
                 except AttributeError:  # photon-lint: disable=swallowed-exception (backends without async D2H: the device_get below copies synchronously)
                     pass
-                lo, hi = self.batch.chunk_slice(i)
-                pending.append((m, hi - lo))
-                _mon.progress("train.pass", i + 1,
-                              self.batch.n_chunks, unit="chunks")
-            if not pending:
-                return np.zeros(0, np.float32)
-            # device_get, not np.asarray: the harvest is a PLANNED
-            # device-to-host copy, and the explicit spelling keeps it
-            # allowed under guards.no_implicit_transfers (the async
-            # copies above already landed most bytes; this just
-            # materializes).
-            return np.concatenate(
-                [jax.device_get(m)[:rows] for m, rows in pending])
+                lo, hi = self.batch.chunk_slice(cid)
+                pending.append((m, cid, hi - lo))
+                _mon.progress("train.pass", ci + 1, steps,
+                              unit="chunks")
+            if fred is None:
+                if not pending:
+                    return np.zeros(0, np.float32)
+                # device_get, not np.asarray: the harvest is a PLANNED
+                # device-to-host copy, and the explicit spelling keeps
+                # it allowed under guards.no_implicit_transfers (the
+                # async copies above already landed most bytes; this
+                # just materializes).
+                return np.concatenate(
+                    [jax.device_get(m)[:rows] for m, _, rows in pending])
+            # Fleet: scatter this host's chunk slices into the full
+            # [n] plane and sum across hosts ONCE at the end (each
+            # example is owned by exactly one host, so the sum IS the
+            # concatenation) — per-example planes take one barrier per
+            # pass, not one per chunk.
+            full = np.zeros(self.batch.n, np.float32)
+            for m, cid, rows in pending:
+                lo, _hi = self.batch.chunk_slice(cid)
+                full[lo:lo + rows] = jax.device_get(m)[:rows]
+            return np.asarray(fred.reduce(full))
 
     def predict_margins(self, w: Array) -> np.ndarray:
         """Per-example margins (offsets included) over all chunks."""
@@ -819,6 +893,25 @@ def _restore_tracker(st: dict):
         step_sizes=opt(st.get("step_sizes")),
         ls_trials=opt(st.get("ls_trials")),
     )
+
+
+def _fleet_seq() -> int:
+    """The fleet reducer's reduction counter for checkpoint trees
+    (-1 outside a fleet).  A resumed host restores it and REPLAYS its
+    reduce sequence — the coordinator answers already-completed
+    sequence numbers from its result cache, so the replay fast-forwards
+    to the live barrier the rest of the fleet is blocked on."""
+    red = _fleet_reducer()
+    return -1 if red is None else int(red.seq)
+
+
+def _restore_fleet_seq(seq) -> None:
+    if seq is None or int(seq) < 0:
+        return
+    red = _fleet_reducer()
+    if red is not None:
+        red.seq = int(seq)
+        telemetry.count("fleet.seq_restored")
 
 
 def _solver_checkpoint(solver_name: str, label: str):
@@ -923,6 +1016,7 @@ def streaming_lbfgs_solve(
         tracker = _restore_tracker(restored["tracker"])
         converged = bool(restored["converged"])
         it = int(restored["it"])
+        _restore_fleet_seq(restored.get("fleet_seq"))
         logger.info("streaming lbfgs '%s': resumed at iteration %d",
                     label, it)
     else:
@@ -1070,6 +1164,7 @@ def streaming_lbfgs_solve(
                 "rho_hist": [float(r) for r in rho_hist],
                 "converged": bool(converged),
                 "tracker": _tracker_state(tracker),
+                "fleet_seq": _fleet_seq(),
             })
 
     if ck is not None:
@@ -1170,6 +1265,7 @@ def streaming_lbfgs_solve_swept(
         t_vals = jnp.asarray(restored["t_vals"], jnp.float32)
         t_gn = jnp.asarray(restored["t_gn"], jnp.float32)
         it = int(restored["it"])
+        _restore_fleet_seq(restored.get("fleet_seq"))
         logger.info("streaming swept lbfgs '%s': resumed at iteration "
                     "%d (%d/%d lanes done)", label, it,
                     int(jnp.sum(done)), L)
@@ -1325,6 +1421,7 @@ def streaming_lbfgs_solve_swept(
                 "S_buf": S_buf, "Y_buf": Y_buf, "Rho": Rho,
                 "head": head, "count": count,
                 "t_vals": t_vals, "t_gn": t_gn,
+                "fleet_seq": _fleet_seq(),
             })
 
     if ck is not None:

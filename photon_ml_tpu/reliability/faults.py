@@ -27,6 +27,14 @@ The serving fault matrix (ISSUE 13) adds the request-path seams:
 - ``serve.replica_healthz`` — fired in the fleet supervisor's probe
   (flaky/wedged health probe → unhealthy-replica restart policy).
 
+The multi-host training fault matrix (ISSUE 16) adds:
+
+- ``fleet.reduce`` — fired in ``FleetReducer.reduce`` before each
+  cross-host reduction, with ``seq=<reduce sequence number>`` context
+  (the ``kill`` kind here simulates a host dying mid-sweep: peers hold
+  at the chunk barrier and the restarted host replays from its
+  per-host checkpoint, answered by the coordinator's done-cache).
+
 A ``FaultInjector`` holds a list of ``Fault`` specs, each targeting a
 site's Nth occurrence (per-site occurrence counters under one lock, so
 multi-threaded sites count deterministically given a deterministic
@@ -51,7 +59,7 @@ from photon_ml_tpu import telemetry
 logger = logging.getLogger(__name__)
 
 KINDS = ("error", "io_error", "enospc", "slow", "corrupt_file",
-         "delete_file")
+         "delete_file", "kill")
 
 
 class InjectedFault(RuntimeError):
@@ -129,6 +137,14 @@ class FaultInjector:
         elif f.kind == "delete_file":
             if path and os.path.exists(path):
                 os.remove(path)
+        elif f.kind == "kill":
+            # Simulated host death (fleet fault matrix): the process dies
+            # without flushing or unwinding, exactly like an OOM-kill or a
+            # preempted VM.  Peers must survive the barrier stall and the
+            # restarted host must resume from its per-host checkpoint.
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
 
 
 def seeded_plan(seed: int, site_kinds: dict[str, str],

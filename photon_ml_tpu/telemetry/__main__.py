@@ -28,6 +28,14 @@ stage per tail request) and optionally exports a Perfetto flow trace
 cross-process join falls below ``--join-threshold``; see
 ``telemetry.serve_report``.
 
+``fleet-report <host_logs...>`` joins a multi-host training run's
+per-host ``run_log.jsonl`` files (ISSUE 16) into one fleet view:
+per-host chunks streamed / reductions / barrier-wait / peak RSS rows,
+the barrier-agreement check (every host must count the same
+reductions), and the fleet-wide sweep odometer (replicated solver
+state ⇒ per-host odometers must agree and each must reconcile); exit
+code 1 on any disagreement; see ``telemetry.fleet_report``.
+
 All subcommands print one machine-parseable JSON object as the last
 stdout line (the repo's CLI contract).
 """
@@ -37,6 +45,7 @@ from __future__ import annotations
 import argparse
 import sys
 
+from photon_ml_tpu.telemetry import fleet_report as fleet_report_mod
 from photon_ml_tpu.telemetry import serve_report as serve_report_mod
 from photon_ml_tpu.telemetry import watch as watch_mod
 from photon_ml_tpu.telemetry.history import (
@@ -116,7 +125,19 @@ def main(argv=None) -> int:
     sp.add_argument("--trace-out", default=None,
                     help="also write a Perfetto-loadable Chrome trace "
                          "with cross-process flow events here")
+    fp = sub.add_parser(
+        "fleet-report",
+        help="join a multi-host training run's per-host run logs into "
+             "one fleet view: per-host chunk/reduce/barrier-wait rows, "
+             "the barrier-agreement check, and the fleet-wide sweep "
+             "odometer")
+    fp.add_argument("logs", nargs="+",
+                    help="per-host run logs (each host_NNN/ output "
+                         "subdir's run_log.jsonl)")
     args = p.parse_args(argv)
+    if args.cmd == "fleet-report":
+        result = fleet_report_mod.run_fleet_report(args.logs)
+        return 0 if result["ok"] else 1
     if args.cmd == "serve-report":
         result = serve_report_mod.run_serve_report(
             args.logs, join_threshold=args.join_threshold,

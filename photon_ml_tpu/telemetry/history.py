@@ -84,6 +84,14 @@ METRICS: tuple[tuple[str, str, str], ...] = (
     # the device path regressed; both gate like every other metric.
     ("serve", "serve.queue_wait_ms", "lower"),
     ("serve", "serve.dispatch_ms", "lower"),
+    # Multi-host out-of-core training (ISSUE 16): the sharded-streaming
+    # claims — fleet throughput dropping, hosts stalling at the chunk
+    # barrier, any host's peak RSS creeping toward its budget, or the
+    # fleet-wide passes/cycle identity drifting above ~1 all gate.
+    ("mesh_stream", "mesh_stream.rows_per_sec", "higher"),
+    ("mesh_stream", "mesh_stream.barrier_wait_fraction", "lower"),
+    ("mesh_stream", "mesh_stream.max_host_peak_rss_mb", "lower"),
+    ("mesh_stream", "mesh_stream.passes_per_cycle", "lower"),
 )
 
 

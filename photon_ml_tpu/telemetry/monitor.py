@@ -613,7 +613,7 @@ class Monitor:
                 }
                 if latest is None or st["updated"] > latest[1]:
                     latest = (name, st["updated"])
-            return {
+            out = {
                 "phase": self._phases[-1] if self._phases else None,
                 "uptime_s": round(now - self.t0, 1),
                 "snapshots": self._snapshots,
@@ -622,6 +622,10 @@ class Monitor:
                 "eta_s": (stages[latest[0]]["eta_s"] if latest else None),
                 "alerts": list(self._alerts),
             }
+        fl = _fleet_status()
+        if fl is not None:
+            out["fleet"] = fl
+        return out
 
     def summary(self) -> dict:
         """Run-end summary (the ``monitor_summary`` event body; bench
@@ -632,6 +636,33 @@ class Monitor:
             "stages": st["stages"],
             "alerts": st["alerts"],
         }
+
+
+def _fleet_status() -> dict | None:
+    """This host's slice of the fleet view for ``/status`` (ISSUE 16):
+    identity + reduce/barrier counters.  Every host serves its own
+    status endpoint; a fleet dashboard polls all of them and joins on
+    ``host`` — the offline equivalent is ``telemetry fleet-report``
+    over the per-host run logs.  None outside a fleet."""
+    from photon_ml_tpu.parallel import fleet
+
+    ctx = fleet.active()
+    if ctx is None or not ctx.is_fleet:
+        return None
+    t = telemetry.active()
+    out = {
+        "host": ctx.host_id,
+        "n_hosts": ctx.n_hosts,
+        "transport": ctx.transport,
+    }
+    if t is not None:
+        out.update({
+            "reduces": t.counter("fleet.psums"),
+            "chunks_streamed": t.counter("fleet.chunks_streamed"),
+            "barrier_wait_s": round(
+                float(t.counter("fleet.barrier_wait_s")), 3),
+        })
+    return out
 
 
 # ---------------------------------------------------------------------------

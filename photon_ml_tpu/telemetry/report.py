@@ -344,6 +344,20 @@ def report(path: str, threshold: float = 0.9, out=None) -> dict:
               f"{counters.get('store.rebuilds', 0)} rebuilds")
         w()
 
+    fleet_reduces = counters.get("fleet.psums")
+    if fleet_reduces:
+        # One host's view of a multi-host run; `telemetry fleet-report`
+        # joins every host's log into the fleet-wide table.
+        w("Fleet (this host's shard):")
+        w(f"  {counters.get('fleet.chunks_streamed', 0)} chunks "
+          f"streamed, {fleet_reduces} cross-host reductions, "
+          f"{counters.get('fleet.barrier_wait_s', 0.0):.3f} s waiting "
+          "at chunk barriers"
+          + (f", {counters.get('fleet.seq_restored')} reduce-seq "
+             "restore(s) (resumed host)"
+             if counters.get("fleet.seq_restored") else ""))
+        w()
+
     conv = _convergence(events, counters)
     if conv is not None:
         w("Convergence:")

@@ -864,6 +864,76 @@ def test_eternal_wait_waiver_with_reason():
 
 
 # ---------------------------------------------------------------------------
+# collective-in-host-branch
+# ---------------------------------------------------------------------------
+
+
+def test_collective_in_host_branch_flags_if():
+    vs = check_source(_src("""
+        import jax
+
+        def reduce_partials(x):
+            if jax.process_index() == 0:
+                return jax.lax.psum(x, "hosts")
+            return x
+    """))
+    assert _rules(vs) == ["collective-in-host-branch"]
+    assert vs[0].line == 5
+
+
+def test_collective_in_host_branch_flags_host_id_and_ifexp():
+    vs = check_source(_src("""
+        from jax import lax
+
+        def f(ctx, x):
+            while ctx.host_id > 0:
+                x = lax.all_gather(x, "hosts")
+            return lax.pmean(x, "h") if ctx.host_id else x
+    """))
+    assert _rules(vs) == ["collective-in-host-branch",
+                          "collective-in-host-branch"]
+
+
+def test_collective_in_host_branch_clean_cases():
+    vs = check_source(_src("""
+        import jax
+
+        def uniform(x):
+            # process_count() is the same on every host: not divergent.
+            if jax.process_count() > 1:
+                return jax.lax.psum(x, "hosts")
+            return x
+
+        def hoisted(x):
+            total = jax.lax.psum(x, "hosts")
+            if jax.process_index() == 0:
+                print(total)
+            return total
+
+        def defined_not_run(x):
+            if jax.process_index() == 0:
+                def helper(y):
+                    # a def boundary ends the lexical branch
+                    return jax.lax.psum(y, "hosts")
+                return helper
+            return None
+    """))
+    assert vs == []
+
+
+def test_collective_in_host_branch_waiver():
+    vs = check_source(_src("""
+        import jax
+
+        def f(x):
+            if jax.process_index() == 0:
+                return jax.lax.psum(x, "hosts")  # photon-lint: disable=collective-in-host-branch (single-host test harness, no peers to deadlock)
+            return x
+    """))
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
 # the acceptance corpus + whole-repo gate + CLI contract
 # ---------------------------------------------------------------------------
 

@@ -507,3 +507,80 @@ def test_bench_zero_budget_still_emits_json(tmp_path):
     assert set(rec["sections_skipped"]) == {
         "etl", "cached", "grr", "segment_sum", "colmajor"}
     assert rec["value"] is None
+
+
+@pytest.mark.fast
+def test_history_spec_watches_mesh_stream():
+    """ISSUE 16 satellite: the history spec gates the multi-host
+    section's claims — fleet throughput, the barrier-wait tax, the
+    per-host peak-RSS bound, and the replicated odometer's
+    passes/cycle."""
+    from photon_ml_tpu.telemetry.history import METRICS
+
+    keys = {(s, p) for s, p, _ in METRICS}
+    assert ("mesh_stream", "mesh_stream.rows_per_sec") in keys
+    assert ("mesh_stream",
+            "mesh_stream.barrier_wait_fraction") in keys
+    assert ("mesh_stream",
+            "mesh_stream.max_host_peak_rss_mb") in keys
+    assert ("mesh_stream", "mesh_stream.passes_per_cycle") in keys
+    directions = {f"{s}:{p}": d for s, p, d in METRICS}
+    assert directions["mesh_stream:mesh_stream.rows_per_sec"] == \
+        "higher"
+    assert directions[
+        "mesh_stream:mesh_stream.barrier_wait_fraction"] == "lower"
+    assert directions[
+        "mesh_stream:mesh_stream.max_host_peak_rss_mb"] == "lower"
+    assert directions["mesh_stream:mesh_stream.passes_per_cycle"] == \
+        "lower"
+
+
+def test_bench_mesh_arm_solo_smoke(tmp_path):
+    """The fast mesh smoke: ONE ``--mesh-arm`` worker with no fleet
+    environment is a single-host control run — rc 0, one JSON line
+    with the arm record (no fleet counters, a live odometer), and the
+    per-host ``run_log.jsonl`` the fleet-report join would consume."""
+    proc = _run_bench(tmp_path, "--mesh-arm", "solo", *_TINY)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert rec["host"] == 0
+    assert rec["transport"] is None
+    assert rec["reduces"] == 0 and rec["chunks_streamed"] == 0
+    assert rec["cycles"] > 0 and rec["data_passes"] > 0
+    assert rec["passes_per_cycle"] is not None
+    assert rec["peak_rss_mb"] > 0
+    assert os.path.exists(rec["run_log"])
+    # Solo run → NOT host-sharded: the log sits at the mesh base dir.
+    assert os.path.dirname(rec["run_log"]).endswith("mesh_stream")
+
+
+@pytest.mark.slow   # MESH_HOSTS concurrent subprocess estimator fits
+def test_bench_mesh_stream_section_contract(tmp_path):
+    """`--section mesh_stream` keeps the budget/JSON-last-line
+    contract and records the multi-host measurement (ISSUE 16): all
+    hosts report one reduce count (barrier agreement), the replicated
+    odometer agrees with passes/cycle ≈ 1, coefficients are bitwise
+    identical across hosts, and the fleet-report join passes."""
+    proc = _run_bench(tmp_path, "--section", "mesh_stream",
+                      "--budget-s", "400", *_TINY, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert rec["section"] == "mesh_stream"
+    assert rec.get("errors") is None, rec["errors"]
+    s = rec["mesh_stream"]
+    assert s["transport"] in ("psum", "tcp")
+    assert len(s["per_host"]) == s["hosts"] == 3
+    assert s["barrier_agreement"] is True
+    assert s["odometer_agreement"] is True
+    assert s["coef_identical_across_hosts"] is True
+    assert s["fleet_report_ok"] is True
+    assert s["reduces_per_host"] > 0
+    assert s["total_chunks_streamed"] > 0
+    assert s["rows_per_sec"] > 0
+    assert s["max_host_peak_rss_mb"] > 0
+    assert s["passes_per_cycle"] <= 1.5    # fused: ~1 (+ score pass)
+    for host in s["per_host"]:
+        assert host["reduces"] == s["reduces_per_host"]
+        assert host["barrier_wait_s"] >= 0

@@ -1,0 +1,381 @@
+"""Multi-host fleet training tests (ISSUE 16).
+
+Three tiers:
+
+- **Schedule unit tests**: ``shard_chunk_ids`` — contiguous shards,
+  ragged grids padded with ``EMPTY_CHUNK`` sentinels to one COMMON
+  per-host step count (the no-collective-deadlock invariant), hosts
+  past the end of the grid, and the per-host directory convention.
+- **Transport tests**: the tcp ``ReduceCoordinator`` star allreduce
+  in-process — deterministic host-order sums, monotone sequence
+  numbers, and the done-cache answering a replayed sequence (the
+  killed-host fast-forward primitive).
+- **End-to-end drills** (subprocess fleets on the tcp transport, so
+  they run on boxes whose jaxlib lacks multiprocess CPU collectives):
+  a 3-host fused-CD fit whose coefficients are BITWISE identical
+  across hosts and match a single-host reference fit, and the fault
+  matrix's kill-one-host drill — one host SIGKILLed mid-sweep at the
+  ``fleet.reduce`` seam, restarted alone with ``resume=True`` while
+  its peer holds the chunk barrier, finishing bitwise-equal to an
+  uninterrupted fleet run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.parallel import fleet
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# shard_chunk_ids: the chunk-synchronized schedule
+# ---------------------------------------------------------------------------
+
+
+def test_shard_chunk_ids_even_split():
+    locals_, schedules = zip(*(fleet.shard_chunk_ids(12, h, 3)
+                               for h in range(3)))
+    assert locals_ == ([0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 10, 11])
+    # No padding on an even grid: schedule == local shard.
+    assert schedules == locals_
+
+
+def test_shard_chunk_ids_ragged_pads_sentinels_last():
+    pairs = [fleet.shard_chunk_ids(7, h, 3) for h in range(3)]
+    # Every chunk owned exactly once, by contiguous ranges.
+    owned = [c for local, _ in pairs for c in local]
+    assert sorted(owned) == list(range(7))
+    # One COMMON step count; sentinels pad at the END (real chunks
+    # first, so prefetch never idles behind a sentinel).
+    schedules = [sched for _, sched in pairs]
+    assert [len(s) for s in schedules] == [3, 3, 3]
+    assert schedules[2] == [6, fleet.EMPTY_CHUNK, fleet.EMPTY_CHUNK]
+    for local, sched in pairs:
+        assert sched[:len(local)] == local
+        assert all(c == fleet.EMPTY_CHUNK for c in sched[len(local):])
+
+
+def test_shard_chunk_ids_host_past_grid_is_all_sentinels():
+    local, sched = fleet.shard_chunk_ids(2, 3, 4)
+    assert local == []
+    assert sched == [fleet.EMPTY_CHUNK]
+    # Zero chunks: zero steps everywhere (degenerate but legal).
+    assert fleet.shard_chunk_ids(0, 1, 4) == ([], [])
+
+
+def test_shard_chunk_ids_validates_host():
+    with pytest.raises(ValueError):
+        fleet.shard_chunk_ids(8, 3, 3)
+    with pytest.raises(ValueError):
+        fleet.shard_chunk_ids(-1, 0, 2)
+
+
+def test_host_dir_shards_only_in_fleet(tmp_path):
+    base = str(tmp_path / "out")
+    ctx = fleet.FleetContext(host_id=2, n_hosts=3, transport="tcp",
+                             coordinator="127.0.0.1:1")
+    assert fleet.host_dir(base, ctx) == os.path.join(base, "host_002")
+    assert fleet.host_dir(base, None) == base
+    solo = fleet.FleetContext(host_id=0, n_hosts=1, transport="tcp")
+    assert fleet.host_dir(base, solo) == base
+
+
+# ---------------------------------------------------------------------------
+# tcp transport: coordinator round trip + replay cache
+# ---------------------------------------------------------------------------
+
+
+def _tree(v: float) -> dict:
+    return {"grad": np.arange(4, dtype=np.float32) * v,
+            "loss": np.float32(v)}
+
+
+def test_tcp_reduce_round_trip_and_replay_cache():
+    coord = fleet.ReduceCoordinator(2)
+    reds = [fleet.FleetReducer(fleet.FleetContext(
+        host_id=h, n_hosts=2, transport="tcp",
+        coordinator=coord.address), stall_timeout_s=30.0)
+        for h in range(2)]
+    try:
+        results: list = [None, None]
+
+        def run(h):
+            for step in range(3):
+                results[h] = reds[h].reduce(_tree(float(h + 1 + step)))
+
+        threads = [threading.Thread(target=run, args=(h,))
+                   for h in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert not any(t.is_alive() for t in threads)
+        # Both hosts hold the SAME fleet total (last step: 3 + 4).
+        for h in range(2):
+            np.testing.assert_array_equal(
+                results[h]["grad"], np.arange(4, dtype=np.float32) * 7)
+            assert float(results[h]["loss"]) == 7.0
+        assert [r.seq for r in reds] == [3, 3]
+        assert coord.reduces == 3
+        assert coord.replays == 0
+
+        # The killed-host fast-forward: rewind ONE host's sequence and
+        # replay — answered from the done cache without any peer at
+        # the barrier, bitwise-equal to the original total.
+        reds[0].seq = 1
+        replayed = reds[0].reduce(_tree(123.0))   # payload irrelevant
+        np.testing.assert_array_equal(
+            replayed["grad"], np.arange(4, dtype=np.float32) * 5)
+        assert coord.replays == 1
+        assert coord.reduces == 3                 # never re-summed
+    finally:
+        for r in reds:
+            r.close()
+        coord.close()
+
+
+def test_single_host_reduce_is_identity():
+    red = fleet.FleetReducer(fleet.FleetContext(host_id=0, n_hosts=1,
+                                                transport="tcp"))
+    tree = _tree(2.0)
+    out = red.reduce(tree)
+    assert out is tree
+    assert red.seq == 0
+
+
+# ---------------------------------------------------------------------------
+# End-to-end fleet drills (subprocess workers, tcp transport)
+# ---------------------------------------------------------------------------
+
+_FLEET_WORKER = r'''
+import json
+import os
+import sys
+
+sys.path.insert(0, os.environ["PML_REPO"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def _workload(n=240, d=24, k=4, d_re=2):
+    rng = np.random.default_rng(7)
+    cols = np.stack([np.sort(rng.choice(d, k, replace=False))
+                     for _ in range(n)]).astype(np.int32)
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    w_true = rng.normal(0, 1, d)
+    ids = np.concatenate([rng.integers(0, 10, (2 * n) // 3),
+                          rng.integers(50, 53, n - (2 * n) // 3)])
+    b_true = rng.normal(0, 0.7, 60)
+    m = np.einsum("nk,nk->n", vals, w_true[cols]) + b_true[ids % 60]
+    y = (m + rng.normal(0, 0.3, n) > 0).astype(np.float32)
+    rows = [(cols[i], vals[i]) for i in range(n)]
+    from photon_ml_tpu.game.dataset import GameDataset
+    return GameDataset(
+        labels=y,
+        features={"f": rows,
+                  "re": rng.normal(0, 1, (n, d_re)).astype(np.float32)},
+        entity_ids={"u": ids}, feature_dims={"f": d})
+
+
+def main():
+    from photon_ml_tpu.parallel import fleet
+    from photon_ml_tpu.reliability import faults
+
+    fleet.initialize_from_env()
+    kill_at = os.environ.get("FLEET_T_KILL_AT")
+    if kill_at:
+        faults.install(faults.FaultInjector([
+            faults.Fault(site="fleet.reduce", kind="kill",
+                         at=int(kill_at))]))
+
+    from photon_ml_tpu.config import (
+        CoordinateConfig, CoordinateKind, OptimizerSettings,
+        TrainingConfig)
+    from photon_ml_tpu.estimators.game_estimator import GameEstimator
+    from photon_ml_tpu.models.glm import TaskType
+
+    out_base = os.environ["FLEET_T_OUT"]
+    cfg = TrainingConfig(
+        task_type=TaskType.LOGISTIC_REGRESSION,
+        coordinates=[
+            CoordinateConfig(name="global",
+                             kind=CoordinateKind.FIXED_EFFECT,
+                             feature_shard="f",
+                             optimizer=OptimizerSettings(
+                                 max_iters=40, reg_weight=1.0,
+                                 tolerance=1e-6)),
+            CoordinateConfig(name="per_u",
+                             kind=CoordinateKind.RANDOM_EFFECT,
+                             feature_shard="re", entity_key="u",
+                             optimizer=OptimizerSettings(
+                                 max_iters=30, reg_weight=2.0,
+                                 tolerance=1e-6)),
+        ],
+        update_sequence=["global", "per_u"],
+        n_iterations=int(os.environ.get("FLEET_T_CYCLES", "6")),
+        intercept=False, chunk_rows=40, chunk_layout="ELL",
+        cd_fused=True, validation_fraction=0.0,
+        validate_per_iteration=False,
+        spill_dir=os.path.join(out_base, "spill"),
+        checkpoint_dir=(os.path.join(out_base, "ckpt")
+                        if os.environ.get("FLEET_T_CKPT") else None),
+        resume=os.environ.get("FLEET_T_RESUME") == "1",
+    )
+    cfg.validate()
+    models = GameEstimator(cfg).fit(_workload())[0].model.models
+    red = fleet.reducer()
+    ctx = fleet.active()
+    print("RESULT " + json.dumps({
+        "fe": np.asarray(
+            models["global"].coefficients.means).tolist(),
+        "re0": np.asarray(
+            models["per_u"].coefficient_blocks[0]).ravel().tolist(),
+        "seq": red.seq if red is not None else -1,
+        "host": ctx.host_id if ctx is not None else -1,
+    }))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
+'''
+
+
+def _spawn_worker(script: str, extra_env: dict) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.update({"PML_REPO": _REPO, "JAX_PLATFORMS": "cpu"})
+    env.update(extra_env)
+    return subprocess.Popen([sys.executable, script], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _result(proc: subprocess.Popen, tag: str, timeout=300.0) -> dict:
+    out, err = proc.communicate(timeout=timeout)
+    assert proc.returncode == 0, (
+        f"{tag} rc={proc.returncode}\n{out[-2000:]}\n{err[-3000:]}")
+    lines = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+    assert lines, f"{tag} printed no RESULT line:\n{out}\n{err[-2000:]}"
+    return json.loads(lines[-1][len("RESULT "):])
+
+
+def _fleet_env(coord: fleet.ReduceCoordinator, host: int,
+               n_hosts: int, out_dir: str, **extra) -> dict:
+    env = {"PHOTON_FLEET_NUM_HOSTS": str(n_hosts),
+           "PHOTON_FLEET_HOST_ID": str(host),
+           "PHOTON_FLEET_COORDINATOR": coord.address,
+           "FLEET_T_OUT": out_dir}
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+@pytest.mark.slow   # 4 subprocess estimator fits
+def test_fleet_fused_fit_bitwise_across_hosts_and_matches_solo(
+        tmp_path):
+    """3 tcp-fleet hosts train the fused-CD workload over sharded
+    chunks; every host ends with BITWISE-identical coefficients (the
+    replicated-state invariant: all hosts apply the same
+    globally-reduced statistics in the same order) that match a
+    single-host fit of the same workload to float tolerance (summation
+    order across chunk shards differs — bitwise is not expected
+    against the solo run, only across fleet hosts)."""
+    script = tmp_path / "worker.py"
+    script.write_text(_FLEET_WORKER)
+    n_hosts = 3
+    coord = fleet.ReduceCoordinator(n_hosts)
+    try:
+        procs = [_spawn_worker(str(script), _fleet_env(
+            coord, h, n_hosts, str(tmp_path / "fleet")))
+            for h in range(n_hosts)]
+        results = [_result(p, f"host{h}")
+                   for h, p in enumerate(procs)]
+    finally:
+        coord.close()
+    solo = _result(_spawn_worker(str(script),
+                                 {"FLEET_T_OUT": str(tmp_path / "solo")}),
+                   "solo")
+
+    fe = [np.asarray(r["fe"]) for r in results]
+    re0 = [np.asarray(r["re0"]) for r in results]
+    for h in range(1, n_hosts):
+        np.testing.assert_array_equal(fe[0], fe[h])
+        np.testing.assert_array_equal(re0[0], re0[h])
+    # Same reduce count on every host == the barrier never skewed.
+    assert len({r["seq"] for r in results}) == 1
+    assert results[0]["seq"] > 0
+    assert coord.reduces == results[0]["seq"]
+    np.testing.assert_allclose(fe[0], np.asarray(solo["fe"]),
+                               atol=5e-4, rtol=0)
+    np.testing.assert_allclose(re0[0], np.asarray(solo["re0"]),
+                               atol=5e-4, rtol=0)
+
+
+@pytest.mark.slow   # 5 subprocess estimator fits incl. the kill drill
+def test_fleet_kill_one_host_resumes_bitwise(tmp_path):
+    """The fault matrix's kill-one-host drill: host 1 of a 2-host tcp
+    fleet is SIGKILLed at its 7th ``fleet.reduce`` (mid-sweep, after
+    at least one per-host checkpoint).  Host 0 is NEVER restarted — it
+    holds the chunk barrier while host 1 alone restarts with
+    ``resume=True``, restores its own ``host_001/`` checkpoint
+    (including the reduce sequence) and fast-forwards through the
+    coordinator's done-cache to the live barrier.  The resumed fleet's
+    coefficients must be BITWISE equal to an uninterrupted fleet
+    run's."""
+    script = tmp_path / "worker.py"
+    script.write_text(_FLEET_WORKER)
+    n_hosts, kill_at = 2, 7
+
+    # Reference: the same 2-host fleet, uninterrupted.
+    coord = fleet.ReduceCoordinator(n_hosts)
+    try:
+        procs = [_spawn_worker(str(script), _fleet_env(
+            coord, h, n_hosts, str(tmp_path / "ref"), FLEET_T_CKPT=1))
+            for h in range(n_hosts)]
+        ref = [_result(p, f"ref-host{h}")
+               for h, p in enumerate(procs)]
+    finally:
+        coord.close()
+
+    # The drill: kill host 1, let host 0 wait, restart ONLY host 1.
+    coord = fleet.ReduceCoordinator(n_hosts)
+    try:
+        out = str(tmp_path / "drill")
+        survivor = _spawn_worker(str(script), _fleet_env(
+            coord, 0, n_hosts, out, FLEET_T_CKPT=1))
+        victim = _spawn_worker(str(script), _fleet_env(
+            coord, 1, n_hosts, out, FLEET_T_CKPT=1,
+            FLEET_T_KILL_AT=kill_at))
+        victim.wait(timeout=300)
+        victim_out, victim_err = victim.communicate()
+        assert victim.returncode == -signal.SIGKILL, (
+            f"victim exited rc={victim.returncode}, not SIGKILL:\n"
+            f"{victim_out[-1000:]}\n{victim_err[-2000:]}")
+        assert survivor.poll() is None, "survivor died with the victim"
+
+        restarted = _spawn_worker(str(script), _fleet_env(
+            coord, 1, n_hosts, out, FLEET_T_CKPT=1, FLEET_T_RESUME=1))
+        r1 = _result(restarted, "restarted-host1")
+        r0 = _result(survivor, "survivor-host0")
+        # The restart replayed its pre-kill reduce prefix from the
+        # coordinator's done-cache instead of re-summing it.
+        assert coord.replays > 0
+    finally:
+        coord.close()
+
+    for r in (r0, r1):
+        np.testing.assert_array_equal(np.asarray(ref[0]["fe"]),
+                                      np.asarray(r["fe"]))
+        np.testing.assert_array_equal(np.asarray(ref[0]["re0"]),
+                                      np.asarray(r["re0"]))
+    assert r0["seq"] == ref[0]["seq"]

@@ -648,16 +648,20 @@ def test_idx_range_native_matches_numpy(rng):
 
 def test_spill_warning_rate_limited(caplog):
     """Satellite (round 8): inside a plan build the per-direction "GRR
-    spill fraction" warning aggregates into ONE max/mean summary
-    (MULTICHIP_r05's tail drowned the dryrun in ~20 identical lines);
-    outside any build scope the immediate warning is preserved."""
+    spill fraction" warning aggregates into ONE count/min/max/mean
+    summary (MULTICHIP_r05's tail drowned the dryrun in ~20 identical
+    lines); outside any build scope (ISSUE 16 satellite) a flagged
+    burst dedupes into a time-windowed summary instead of one raw line
+    per call."""
     import logging
 
     from photon_ml_tpu.data.grr import _spill_warnings
 
     with caplog.at_level(logging.WARNING, logger="photon_ml_tpu.data.grr"):
         caplog.clear()
-        with _spill_warnings:
+        _spill_warnings.note(1, 100)            # stale unscoped clean
+        with _spill_warnings:                   # build: discarded on
+            # scope entry — must NOT inflate this scope's denominator
             for _ in range(20):
                 _spill_warnings.note(20, 100)   # 20% on the XLA path
             _spill_warnings.note(1, 100)        # under threshold
@@ -665,7 +669,8 @@ def test_spill_warning_rate_limited(caplog):
         assert len(caplog.records) == 1
         msg = caplog.records[0].getMessage()
         assert "20 of 21 direction builds" in msg
-        assert "max 20.0%" in msg and "mean 20.0%" in msg
+        assert ("min 20.0%" in msg and "max 20.0%" in msg
+                and "mean 20.0%" in msg)
 
         caplog.clear()
         with _spill_warnings:                   # clean builds: no line
@@ -673,9 +678,44 @@ def test_spill_warning_rate_limited(caplog):
         assert not caplog.records
 
         caplog.clear()
+        _spill_warnings._last_emit = None       # fresh dedupe window
         _spill_warnings.note(20, 100)           # outside a build scope
+        assert len(caplog.records) == 1         # first one is immediate
+        assert "1 of 1 direction builds" in \
+            caplog.records[0].getMessage()
+        for _ in range(10):                     # burst inside the window
+            _spill_warnings.note(30, 100)
+        assert len(caplog.records) == 1         # ...buffers silently
+        _spill_warnings._last_emit = -1e9       # window elapsed
+        _spill_warnings.note(40, 100)
+        assert len(caplog.records) == 2         # ONE summary for the burst
+        msg = caplog.records[1].getMessage()
+        assert "11 of 11 direction builds" in msg
+        assert "min 30.0%" in msg and "max 40.0%" in msg
+
+
+def test_spill_warning_unscoped_burst_flushed_by_scope(caplog):
+    """An unscoped buffered burst is flushed (as its own summary) when
+    a build scope opens, so the scope's summary counts only its own
+    direction builds."""
+    import logging
+
+    from photon_ml_tpu.data.grr import _spill_warnings
+
+    with caplog.at_level(logging.WARNING, logger="photon_ml_tpu.data.grr"):
+        caplog.clear()
+        _spill_warnings._last_emit = None
+        _spill_warnings.note(20, 100)           # immediate (1 of 1)
+        _spill_warnings.note(25, 100)           # buffered in the window
         assert len(caplog.records) == 1
-        assert "20.0% (20 of 100)" in caplog.records[0].getMessage()
+        with _spill_warnings:
+            assert len(caplog.records) == 2     # burst flushed at enter
+            assert "1 of 1 direction builds" in \
+                caplog.records[1].getMessage()
+            _spill_warnings.note(30, 100)
+        assert len(caplog.records) == 3
+        assert "1 of 1 direction builds" in \
+            caplog.records[2].getMessage()
 
 
 def test_spill_warning_aggregates_across_sharded_builds(caplog):
