@@ -78,7 +78,7 @@ V5E_PEAK_GBPS = PLATFORM_PEAK_GBPS["tpu"][0]
 DEFAULT_SECTIONS = ("etl", "cached", "grr", "segment_sum", "colmajor")
 ALL_SECTIONS = DEFAULT_SECTIONS + ("powerlaw", "chunked", "sweep",
                                    "stream", "score", "re", "cd_fused",
-                                   "serve", "mesh_stream")
+                                   "serve", "mesh_stream", "tron")
 DEFAULT_BUDGET_S = 840.0
 DEFAULT_N, DEFAULT_D, DEFAULT_K = 1_000_000, 100_000, 30
 
@@ -149,6 +149,26 @@ MESH_CHUNKS = 12
 MESH_WINDOW = 2
 MESH_DEPTH = 2
 MESH_CYCLES = 10
+
+# Streaming TRON section shape (ISSUE 17): the SAME ill-conditioned
+# chunked logistic problem solved twice to the SAME relative gradient
+# tolerance — streamed trust-region Newton (chunk-accumulated HVPs,
+# Jacobi-preconditioned Steihaug-CG) vs streamed L-BFGS — each arm in
+# its own subprocess for honest per-arm RSS.  Ill-conditioning comes
+# from power-law per-column feature scales spanning TRON_SCALE_DECADES
+# decades: the Hessian diagonal then spans ~2×decades decades, which a
+# diagonal-preconditioned Newton absorbs into its change of variables
+# while limited-memory quasi-Newton pays for it in data passes — the
+# pass-count gap IS the section's claim.  The chunk grid keeps the
+# store-bounded discipline of the stream section (chunks ≥ 4× the host
+# window) so the HVP pass's RSS story is a real out-of-core claim.
+TRON_CHUNKS = 8
+TRON_WINDOW = 2
+TRON_DEPTH = 2
+TRON_SCALE_DECADES = 2.5   # per-column scale span 10^0 .. 10^-2.5
+TRON_L2 = 0.1              # small enough that the scale span survives
+TRON_TOL = 1e-5            # shared relative gradient tolerance
+TRON_MAX_ITERS = 500       # generous cap: L-BFGS must REACH tol
 
 # Serve section shape (ISSUE 12): a subprocess-isolated model server
 # (honest per-process RSS, real socket path) under SERVE_CLIENTS
@@ -230,6 +250,10 @@ SECTION_EST_S = {
     # the chunks (the passes themselves are ~1/HOSTS of a cd_fused
     # pass, but the fixed per-worker costs dominate at bench shapes).
     "mesh_stream": 480.0,
+    # Two subprocess arms × (chunk ETL + a short warm solve + the
+    # measured solve-to-tolerance: tens of streamed passes TRON,
+    # potentially hundreds L-BFGS on the ill-conditioned shape).
+    "tron": 480.0,
 }
 
 
@@ -2650,6 +2674,241 @@ def _serve_fleet_arm(ctx: BenchContext, base_cfg_path: str,
           f"{s['fleet']['p99_ms']} ms", file=sys.stderr)
 
 
+def _make_tron_problem(n: int, d: int, k: int):
+    """Ill-conditioned sparse logistic problem: the ``_make_ell``
+    structure with per-column power-law scales (10^0 down to
+    10^-TRON_SCALE_DECADES across the column range) folded into the
+    values, and labels drawn from a realizable margin whose true
+    coefficients are inversely scaled — every scale decade carries
+    signal, so the fit must travel a real distance in the flat
+    directions, exactly where limited-memory quasi-Newton pays."""
+    rng = np.random.default_rng(17)
+    cols, vals, _ = _make_ell(n, d, k, seed=17)
+    expo = -TRON_SCALE_DECADES / max(d - 1, 1)
+    vals = vals * np.power(10.0, expo * cols).astype(np.float32)
+    w_true = (rng.normal(0, 1.0, d)
+              / np.power(10.0, expo * np.arange(d))).astype(np.float32)
+    m = np.einsum("nk,nk->n", vals, w_true[cols])
+    labels = (rng.uniform(size=n)
+              < 1.0 / (1.0 + np.exp(-np.clip(m, -30, 30))))
+    return cols, vals, labels.astype(np.float32)
+
+
+def tron_arm_main(args) -> int:
+    """One arm of the ``tron`` section in its OWN process (per-arm
+    ``ru_maxrss`` honesty, as in ``stream_arm_main``): the same
+    ill-conditioned chunked logistic problem solved to the same
+    relative gradient tolerance by the streamed TRON
+    (chunk-accumulated HVPs) or the streamed L-BFGS.  A short warm
+    solve pays every XLA compile — the per-chunk value+gradient / HVP
+    / Hessian-diag programs and the host loop's scalar helpers —
+    outside the telemetry window and the RSS sampler (the warm solve is
+    the identical solve: host loops compile lazily along the
+    trajectory, so only a same-trajectory warm covers every program),
+    and the measured solve's ``compiles`` is the
+    zero-new-compiles-after-warm-up claim.
+    Passes-to-tolerance is the ``solver.sweeps`` odometer over the
+    measured solve — the number every acceptance claim rides on.
+    Emits one JSON line; saves final weights for the parent's
+    cross-arm parity check."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.data.chunked_batch import build_chunked_batch
+    from photon_ml_tpu.data.normalization import NormalizationContext
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optim.base import OptimizerConfig
+    from photon_ml_tpu.optim.streaming import (
+        ChunkedGLMObjective,
+        streaming_lbfgs_solve,
+        streaming_tron_solve,
+    )
+
+    arm = args.tron_arm
+    n, d, k = args.n, args.d, args.k
+    cols, vals, labels = _make_tron_problem(n, d, k)
+    rows_sp = SparseRows.from_flat(
+        np.arange(n + 1, dtype=np.int64) * k,
+        cols.reshape(-1).astype(np.int64), vals.reshape(-1))
+    obj = GLMObjective(
+        loss=losses.LOGISTIC,
+        reg=RegularizationContext.l2(TRON_L2),
+        norm=NormalizationContext.identity(),
+    )
+    base_mb = _current_rss_mb()
+    t0 = time.time()
+    cb = build_chunked_batch(
+        rows_sp, d, labels, n_chunks=TRON_CHUNKS, layout="ell",
+        spill_dir=os.path.join(args.cache_dir, f"spill_tron_{arm}"),
+        host_max_resident=TRON_WINDOW)
+    cobj = ChunkedGLMObjective(obj, cb, max_resident=0,
+                               prefetch_depth=TRON_DEPTH)
+    etl_s = time.time() - t0
+    w0 = jnp.zeros(d, jnp.float32)
+    cfg = OptimizerConfig(max_iters=TRON_MAX_ITERS, tolerance=TRON_TOL)
+
+    def solve(c):
+        if arm == "tron":
+            return streaming_tron_solve(
+                cobj.value_and_gradient, cobj.hvp_pass, w0, c,
+                hessian_diag=cobj.hessian_diagonal)
+        return streaming_lbfgs_solve(cobj.value_and_gradient, w0, c)
+
+    # Warm-up is the IDENTICAL solve (same config, same w0): both host
+    # loops compile programs lazily along the trajectory — TRON's
+    # boundary-exit helper only on the first trust-region wall hit,
+    # L-BFGS's two-loop scalars only once curvature history exists — so
+    # a cheaper warm (loose tolerance, short cap) leaves late-iteration
+    # programs to register against the measured solve's zero-compile
+    # claim.  First run pays every compile; second run is measured.
+    t0 = time.time()
+    solve(cfg)
+    warmup_s = time.time() - t0
+
+    tel = telemetry.start("metrics")
+    guard_stack = ExitStack()
+    compile_log = None
+    if args.guards:
+        from photon_ml_tpu.analysis.guards import (
+            count_compiles,
+            no_implicit_transfers,
+        )
+
+        compile_log = guard_stack.enter_context(count_compiles())
+        guard_stack.enter_context(no_implicit_transfers("log"))
+    t0 = time.time()
+    with guard_stack, _RssSampler() as rss:
+        res = solve(cfg)
+    solve_s = time.time() - t0
+    tel_summary = tel.summary()
+    tel.close()
+
+    c = tel_summary.get("counters", {})
+    d_ = tel_summary.get("derived", {})
+    passes = c.get("solver.sweeps", 0)
+    pass_total_s = d_.get("pass_span_total_s") or None
+    np.save(os.path.join(args.cache_dir, f"tron_w_{arm}.npy"),
+            np.asarray(res.w))
+
+    rec = {
+        "arm": arm,
+        "etl_s": round(etl_s, 1),
+        "warmup_s": round(warmup_s, 1),
+        "solve_s": round(solve_s, 2),
+        "iterations": int(res.iterations),
+        "converged": bool(res.converged),
+        "grad_norm": float(res.grad_norm),
+        "final_value": round(float(res.value), 6),
+        "passes_to_tol": passes,
+        "hvp_passes": c.get("solver.hvp_sweeps", 0),
+        "ls_trials": c.get("solver.ls_trials", 0),
+        "aux_passes": c.get("solver.aux_sweeps", 0),
+        "pass_s": (round(pass_total_s / passes, 3)
+                   if pass_total_s and passes else None),
+        # Rows streamed through the device per second of pass span —
+        # the streamed-throughput number the history gate watches.
+        "rows_per_sec": (round(n * passes / pass_total_s, 1)
+                         if pass_total_s else None),
+        "n_chunks": TRON_CHUNKS,
+        "peak_rss_mb": round(_peak_rss_mb(), 1),
+        "solve_peak_rss_mb": round(rss.peak_mb, 1),
+        "rss_delta_mb": (round(rss.peak_mb - base_mb, 1)
+                         if base_mb is not None else None),
+        "telemetry": _telemetry_block(tel_summary),
+    }
+    if compile_log is not None:
+        rec["guards"] = {
+            "solve_compiles": compile_log.count,
+            "solve_compile_programs": sorted(set(compile_log.programs)),
+            "transfer_guard": "log",
+        }
+    print(json.dumps(rec))
+    return 0
+
+
+def section_tron(ctx: BenchContext) -> None:
+    """Streaming TRON vs streaming L-BFGS (ISSUE 17 tentpole
+    measurement): the same ill-conditioned out-of-core logistic problem
+    solved to the same relative gradient tolerance in two subprocess
+    arms.  Claims under test: total data passes to tolerance
+    measurably below the L-BFGS arm's (the second-order pass
+    advantage), streamed throughput in the same regime as the L-BFGS
+    passes (the HVP pass is one more store-bounded sweep, not a new
+    memory tier), per-arm peak RSS bounded by the chunk window, and
+    cross-arm coefficient parity at convergence."""
+    import shutil
+    import subprocess
+
+    for arm in ("tron", "lbfgs"):
+        shutil.rmtree(os.path.join(ctx.cache_dir, f"spill_tron_{arm}"),
+                      ignore_errors=True)   # honest cold spill ETL
+
+    def run_arm(arm: str) -> dict:
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--tron-arm", arm, "--n", str(ctx.n), "--d", str(ctx.d),
+             "--k", str(ctx.k), "--cache-dir", ctx.cache_dir]
+            + (["--no-compile-cache"] if ctx.no_compile_cache else [])
+            + (["--guards"] if ctx.guards else []),
+            capture_output=True, text=True,
+            timeout=max(60.0, ctx.remaining()),
+        )
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            raise RuntimeError(f"tron arm {arm!r} failed "
+                               f"(rc={proc.returncode}): "
+                               f"{proc.stderr[-500:]}")
+        rec = json.loads(
+            [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+        rec["arm_wall_s"] = round(time.time() - t0, 1)
+        return rec
+
+    tron = run_arm("tron")
+    lbfgs = run_arm("lbfgs")
+    w_t = np.load(os.path.join(ctx.cache_dir, "tron_w_tron.npy"))
+    w_l = np.load(os.path.join(ctx.cache_dir, "tron_w_lbfgs.npy"))
+    parity = float(np.max(np.abs(w_t - w_l)))
+
+    def ratio(a, b):
+        if a is None or b is None or b == 0:
+            return None
+        return round(a / b, 3)
+
+    ctx.record["tron"] = {
+        "n_chunks": TRON_CHUNKS,
+        "host_max_resident": TRON_WINDOW,
+        "prefetch_depth": TRON_DEPTH,
+        "scale_decades": TRON_SCALE_DECADES,
+        "tolerance": TRON_TOL,
+        "tron": tron,
+        "lbfgs": lbfgs,
+        # The three gated numbers (history METRICS): the TRON arm's
+        # own trajectory — its pass advantage is gated via the ratio.
+        "passes_to_tol": tron["passes_to_tol"],
+        "rows_per_sec": tron["rows_per_sec"],
+        "peak_rss_mb": tron["solve_peak_rss_mb"],
+        # >1 means TRON reached the tolerance in fewer data passes.
+        "pass_advantage": ratio(lbfgs["passes_to_tol"],
+                                tron["passes_to_tol"]),
+        "pass_time_ratio": ratio(tron["pass_s"], lbfgs["pass_s"]),
+        "coef_parity_max": parity,
+    }
+    s = ctx.record["tron"]
+    print(f"tron: {tron['passes_to_tol']} passes to tol "
+          f"({tron['iterations']} iters, conv {tron['converged']}, "
+          f"{tron['pass_s']}s/pass, peak RSS "
+          f"{tron['solve_peak_rss_mb']} MB) vs lbfgs "
+          f"{lbfgs['passes_to_tol']} passes ({lbfgs['iterations']} "
+          f"iters, conv {lbfgs['converged']}); pass advantage "
+          f"{s['pass_advantage']}x, pass-time ratio "
+          f"{s['pass_time_ratio']}x, coef parity {parity:.2e}",
+          file=sys.stderr)
+
+
 SECTION_FNS = {
     "etl": section_etl,
     "cached": section_cached,
@@ -2665,6 +2924,7 @@ SECTION_FNS = {
     "cd_fused": section_cd_fused,
     "serve": section_serve,
     "mesh_stream": section_mesh_stream,
+    "tron": section_tron,
 }
 
 
@@ -2787,6 +3047,10 @@ def main(argv: list[str] | None = None) -> int:
                         "section in this process (fleet identity comes "
                         "from the environment; without fleet env vars "
                         "this is a single-host control run)")
+    p.add_argument("--tron-arm", choices=("tron", "lbfgs"),
+                   default=None,
+                   help="internal: run ONE arm of the tron section "
+                        "in this process (per-arm peak-RSS isolation)")
     args = p.parse_args(argv)
     if args.cache_dir is None:
         # Per-user default: a fixed shared-/tmp path would let another
@@ -2817,6 +3081,8 @@ def main(argv: list[str] | None = None) -> int:
         return cd_fused_arm_main(args)
     if args.mesh_arm:
         return mesh_arm_main(args)
+    if args.tron_arm:
+        return tron_arm_main(args)
 
     import jax
 

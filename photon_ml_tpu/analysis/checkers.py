@@ -38,6 +38,12 @@ established by hand and a later tier could silently regress:
   (ISSUE 13: the serving tier's wedged-handler class of outage).
   Every cross-thread wait must be bounded, or waived with the reason
   the block is provably terminated (e.g. a close() sentinel).
+- ``while-loop-carry-dtype``: a ``lax.while_loop`` body whose carry
+  leaf changes dtype fails at trace time with an opaque
+  body-function-output-mismatch error (ISSUE 17: an f64 cast — or a
+  float literal folded into an int/bool carry — silently rewrites the
+  leaf's dtype).  Flags mismatched-literal arithmetic on carry names
+  inside while-body functions whose init dtype is statically inferable.
 - ``slow-unmarked``: tests whose recorded tier-1 duration exceeds the
   threshold must carry ``@pytest.mark.slow`` so the tier-1 wall clock
   stops creeping (durations recorded once in
@@ -119,6 +125,12 @@ RULES = {
         "the process identity (process_index()/host_id) — hosts that "
         "skip the branch never reach the collective and the fleet "
         "deadlocks at the barrier"
+    ),
+    "while-loop-carry-dtype": (
+        "arithmetic on a lax.while_loop carry name whose literal "
+        "operand changes the carry leaf's dtype (f64 cast, or a float "
+        "literal on an int/bool carry) — the body/carry dtype mismatch "
+        "fails at trace time with an opaque error"
     ),
     "slow-unmarked": (
         "test measured slower than the threshold lacks "
@@ -1104,6 +1116,194 @@ def check_collective_in_host_branch(ctx: _FileContext):
 
 
 # ---------------------------------------------------------------------------
+# Rule: while-loop-carry-dtype
+# ---------------------------------------------------------------------------
+
+
+def _literal_class(node: ast.AST) -> str | None:
+    """Best-effort dtype CLASS ('bool'/'int'/'float') of a carry-init
+    expression, from literal structure only.  None = not inferable
+    (Name, general Call, ...) — such positions are never flagged."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bool):
+            return "bool"
+        if isinstance(node.value, int):
+            return "int"
+        if isinstance(node.value, float):
+            return "float"
+        return None
+    if isinstance(node, ast.Compare):
+        return "bool"
+    if isinstance(node, ast.UnaryOp):
+        return _literal_class(node.operand)
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func) or ""
+        tail = d.split(".")[-1]
+
+        def cls_of(name: str) -> str | None:
+            if "bool" in name:
+                return "bool"
+            if "int" in name:
+                return "int"
+            if "float" in name or name == "double":
+                return "float"
+            return None
+
+        # An explicit dtype argument wins (jnp.asarray(0, jnp.int32),
+        # jnp.zeros(n, dtype=jnp.float32), ...).
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            dt = _dotted(arg)
+            if dt is not None:
+                c = cls_of(dt.split(".")[-1])
+                if c:
+                    return c
+        if cls_of(tail):                       # jnp.int32(...), float(...)
+            return cls_of(tail)
+        if tail in ("asarray", "array") and node.args:
+            return _literal_class(node.args[0])
+        if tail in ("logical_and", "logical_or", "logical_not"):
+            return "bool"
+        if tail in ("zeros", "ones", "full", "zeros_like", "ones_like"):
+            return "float"                     # jnp default dtype
+    return None
+
+
+def _is_float_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.UnaryOp):
+        node = node.operand
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, float))
+
+
+def _is_number_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.UnaryOp):
+        node = node.operand
+    return (isinstance(node, ast.Constant)
+            and not isinstance(node.value, bool)
+            and isinstance(node.value, (int, float)))
+
+
+def _is_f64_cast(node: ast.AST) -> bool:
+    """``np.float64(...)`` / ``jnp.float64(...)`` / ``np.double(...)``
+    — a concrete f64 value (not a weak Python literal) whose fold
+    promotes an f32 carry under x64."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func) or ""
+    return d.split(".")[-1] in ("float64", "double")
+
+
+def _carry_classes(body, init) -> dict:
+    """{carry_name: dtype_class | None} for a while-body function.
+
+    Names come from the body's single carry parameter: the parameter
+    itself (single-leaf carry), or the targets of a top-level
+    ``a, b, c = <param>`` unpack matched positionally against a literal
+    init tuple at the call site.  Dataclass carries and cross-function
+    inits resolve to no names — never flagged (the rule only fires
+    where the init dtype is statically known)."""
+    args = body.args.args
+    if len(args) != 1:
+        return {}
+    param = args[0].arg
+    if not isinstance(init, (ast.Tuple, ast.List)):
+        return {param: _literal_class(init)}
+    if not isinstance(body, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return {}                      # lambda cannot tuple-unpack
+    for st in body.body:
+        if (isinstance(st, ast.Assign) and len(st.targets) == 1
+                and isinstance(st.targets[0], (ast.Tuple, ast.List))
+                and isinstance(st.value, ast.Name)
+                and st.value.id == param):
+            targets = st.targets[0].elts
+            if len(targets) != len(init.elts):
+                return {}
+            return {t.id: _literal_class(e)
+                    for t, e in zip(targets, init.elts)
+                    if isinstance(t, ast.Name)}
+    return {}
+
+
+def check_while_carry_dtype(ctx: _FileContext):
+    """A ``lax.while_loop`` body must return every carry leaf with the
+    init's dtype — JAX rejects the mismatch at trace time with an
+    opaque "body function output ... differs from the carry" error far
+    from the offending expression.  The classic folds: a float literal
+    into an int/bool carry (``it + 1.0`` on an int32 counter turns the
+    leaf weak-f32), and an explicit f64 cast into an f32 carry.  Only
+    carry names whose init dtype is statically inferable are checked;
+    waive with the reason the fold provably preserves the dtype."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func) or ""
+        if d.split(".")[-1] != "while_loop" or len(node.args) < 3:
+            continue
+        body_arg, init = node.args[1], node.args[2]
+        body = None
+        if isinstance(body_arg, ast.Lambda):
+            body = body_arg
+        elif isinstance(body_arg, ast.Name):
+            # Nearest enclosing scope's def of that name (while bodies
+            # are conventionally local helpers).
+            for anc in (*_ancestors(node, ctx.parents), ctx.tree):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Module)):
+                    for n in ast.walk(anc):
+                        if (isinstance(n, ast.FunctionDef)
+                                and n.name == body_arg.id):
+                            body = n
+                            break
+                if body is not None:
+                    break
+        if body is None:
+            continue
+        classes = _carry_classes(body, init)
+        if not any(classes.values()):
+            continue
+        for sub in ast.walk(body):
+            if isinstance(sub, ast.BinOp):
+                pairs = ((sub.left, sub.right), (sub.right, sub.left))
+            elif isinstance(sub, ast.AugAssign):
+                pairs = ((sub.target, sub.value),)
+            else:
+                continue
+            for carry_side, other in pairs:
+                if not (isinstance(carry_side, ast.Name)
+                        and carry_side.id in classes):
+                    continue
+                cls = classes[carry_side.id]
+                if cls == "int" and _is_float_literal(other):
+                    yield Violation(
+                        ctx.path, sub.lineno, "while-loop-carry-dtype",
+                        f"float literal folded into int carry "
+                        f"'{carry_side.id}' (init at line "
+                        f"{init.lineno}): the leaf turns weak-f32 and "
+                        "the while_loop carry dtype check fails at "
+                        "trace time — use an int literal or cast "
+                        "explicitly outside the carry")
+                    break
+                if cls == "bool" and _is_number_literal(other):
+                    yield Violation(
+                        ctx.path, sub.lineno, "while-loop-carry-dtype",
+                        f"numeric literal folded into bool carry "
+                        f"'{carry_side.id}' (init at line "
+                        f"{init.lineno}): the leaf leaves bool and the "
+                        "while_loop carry dtype check fails at trace "
+                        "time — use jnp.logical_* on bool carries")
+                    break
+                if cls is not None and _is_f64_cast(other):
+                    yield Violation(
+                        ctx.path, sub.lineno, "while-loop-carry-dtype",
+                        f"float64 cast folded into carry "
+                        f"'{carry_side.id}' (init at line "
+                        f"{init.lineno}): under x64 the promoted leaf "
+                        "no longer matches the f32 init — keep carry "
+                        "arithmetic in the carry's own dtype")
+                    break
+
+
+# ---------------------------------------------------------------------------
 # Rule: slow-unmarked (repo-level: needs the recorded durations)
 # ---------------------------------------------------------------------------
 
@@ -1195,6 +1395,7 @@ _FILE_CHECKERS = (
     check_swallowed_exception,
     check_eternal_wait,
     check_collective_in_host_branch,
+    check_while_carry_dtype,
 )
 
 

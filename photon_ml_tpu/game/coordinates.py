@@ -524,12 +524,16 @@ class ChunkedFixedEffectCoordinate(Coordinate):
     Same ``train``/``score`` contract as ``FixedEffectCoordinate``; the
     solve is the host-driven ``optim.streaming.streaming_lbfgs_solve``
     over a ``ChunkedGLMObjective`` (per-chunk device programs, exact
-    chunk-accumulated objective).  When the chunked batch is
-    disk-spilled (``spill_dir`` — the out-of-core tier), every training
-    AND ``_per_example`` scoring sweep runs the async disk→host→device
+    chunk-accumulated objective), or ``streaming_tron_solve`` when the
+    optimizer is TRON (ISSUE 17: chunk-accumulated Hessian-vector
+    passes feed the Steihaug-CG inner loop, Jacobi-preconditioned from
+    the Hessian-diagonal pass).  When the chunked batch is disk-spilled
+    (``spill_dir`` — the out-of-core tier), every training AND
+    ``_per_example`` scoring sweep runs the async disk→host→device
     prefetch pipeline, ``prefetch_depth`` chunks ahead.  Down-sampling
-    views and TRON are not supported on this path (documented config
-    error)."""
+    views are not supported on this path (documented config error);
+    TRON λ-sweeps stay per-grid-point (``train_swept`` is the L-BFGS
+    lane workload, as on the resident path)."""
 
     name: str
     chunked: "object"                 # data.chunked_batch.ChunkedBatch
@@ -542,13 +546,8 @@ class ChunkedFixedEffectCoordinate(Coordinate):
     traces_convergence = True         # the streaming solvers emit live
 
     def __post_init__(self):
-        from photon_ml_tpu.optim.base import OptimizerType
         from photon_ml_tpu.optim.streaming import ChunkedGLMObjective
 
-        if self.optimizer == OptimizerType.TRON:
-            raise ValueError(
-                "chunked training supports LBFGS/OWL-QN only (TRON's "
-                "inner CG would stream the dataset once per CG step)")
         self._obj = ChunkedGLMObjective(
             self.objective, self.chunked, max_resident=self.max_resident,
             prefetch_depth=self.prefetch_depth)
@@ -586,7 +585,11 @@ class ChunkedFixedEffectCoordinate(Coordinate):
 
     def train(self, offsets: Array, warm_start: Array | None = None,
               donate_warm_start: bool = False):
-        from photon_ml_tpu.optim.streaming import streaming_lbfgs_solve
+        from photon_ml_tpu.optim.base import OptimizerType
+        from photon_ml_tpu.optim.streaming import (
+            streaming_lbfgs_solve,
+            streaming_tron_solve,
+        )
 
         self.chunked.set_offsets(self._coerce_offsets(offsets))
         self._obj.invalidate()
@@ -595,9 +598,19 @@ class ChunkedFixedEffectCoordinate(Coordinate):
         problem = self.problem
         l1 = (problem._l1_vector(self.chunked.dim) if problem.has_l1()
               else None)
-        res = streaming_lbfgs_solve(
-            self._obj.value_and_gradient, w0, self.config, l1_weight=l1,
-            value_fn=self._obj.value, label=self.name)
+        if self.optimizer == OptimizerType.TRON:
+            if l1 is not None:
+                raise ValueError(
+                    "TRON supports smooth objectives only (no L1) — "
+                    "as on the resident path")
+            res = streaming_tron_solve(
+                self._obj.value_and_gradient, self._obj.hvp_pass, w0,
+                self.config, hessian_diag=self._obj.hessian_diagonal,
+                label=self.name)
+        else:
+            res = streaming_lbfgs_solve(
+                self._obj.value_and_gradient, w0, self.config,
+                l1_weight=l1, value_fn=self._obj.value, label=self.name)
         return res.w, res
 
     def train_swept(self, offsets: Array, reg, warm_start=None):
@@ -608,10 +621,16 @@ class ChunkedFixedEffectCoordinate(Coordinate):
 
         Same contract as ``FixedEffectCoordinate.train_swept``.
         """
+        from photon_ml_tpu.optim.base import OptimizerType
         from photon_ml_tpu.optim.streaming import (
             streaming_lbfgs_solve_swept,
         )
 
+        if self.optimizer == OptimizerType.TRON:
+            raise ValueError(
+                "train_swept supports LBFGS/OWL-QN lanes only (the λ "
+                "sweep is the L-BFGS grid workload; fit TRON "
+                "coordinates per grid point)")
         self.chunked.set_offsets(self._coerce_offsets(offsets))
         self._obj.invalidate()
         L = reg.n_lanes

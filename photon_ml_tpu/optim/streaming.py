@@ -68,6 +68,13 @@ from photon_ml_tpu.optim.base import (
     loss_converged,
 )
 from photon_ml_tpu.optim.lbfgs import _pseudo_gradient
+from photon_ml_tpu.optim.tron import (
+    _DELTA_MIN,
+    _ETA0,
+    _SIGMA1,
+    _SIGMA3,
+    _boundary_tau,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -743,6 +750,32 @@ class ChunkedGLMObjective:
             hd = hd + self.objective.prior.hessian_diagonal()
         return hd
 
+    def hvp_pass(self, w: Array, v: Array) -> Array:
+        """One chunk-accumulated H(w)·v data pass for Steihaug CG
+        (ISSUE 17).
+
+        Same math as ``hessian_vector`` — each chunk's J^T D J v
+        partial is one module-jitted device program, fleet psum-reduced
+        per chunk, with the L2/prior curvature added ONCE outside the
+        chunk loop (example-independent, so the pass stays exact) — but
+        accounted under ``solver.hvp_sweeps``: CG inner-loop passes are
+        the quantity the TRON-vs-L-BFGS comparison is ABOUT, so the
+        sweep odometer attributes them to their own bucket instead of
+        folding them into ``aux_sweeps`` (variance/diagnostic passes).
+        """
+        w = jnp.asarray(w, jnp.float32)
+        v = jnp.asarray(v, jnp.float32)
+        telemetry.count("solver.hvp_sweeps")
+        hv = self._sweep(lambda b: _jit_hvp(self._inner, w, v, b),
+                         lambda a, x: a + x,
+                         cost=("chunk_hvp", _jit_hvp,
+                               lambda b: (self._inner, w, v, b)),
+                         zero=lambda: jnp.zeros_like(w))
+        hv = hv + self.objective.reg.l2_hessian_vector(v)
+        if self.objective.prior is not None:
+            hv = hv + self.objective.prior.hessian_vector(v)
+        return hv
+
     # -- swept (stacked λ-lane) surface ------------------------------------
 
     def _lane_reg(self, W: Array, reg: SweptRegularization | None,
@@ -1174,6 +1207,249 @@ def streaming_lbfgs_solve(
         w=w,
         value=f,
         grad_norm=jnp.linalg.norm(pg_f),
+        iterations=jnp.asarray(it, jnp.int32),
+        converged=jnp.asarray(converged),
+        tracker=tracker,
+    )
+    _conv.solve_trace(solver_name, label, result)
+    return result
+
+
+def streaming_tron_solve(
+    value_and_grad,
+    hvp,
+    w0: Array,
+    config: OptimizerConfig = OptimizerConfig(),
+    hessian_diag=None,
+    label: str = "",
+) -> OptimizationResult:
+    """Host-driven trust-region Newton over a chunk-streamed objective
+    — the out-of-core mirror of ``optim.tron.tron_solve`` (ISSUE 17).
+
+    Same math as the resident solver (Steihaug CG inside the Lin–Moré
+    radius schedule, identical accept/shrink constants), but both loops
+    run on the host because every Hessian-vector product is a full
+    chunk-streamed data pass: ``hvp(w, v)`` is
+    ``ChunkedGLMObjective.hvp_pass`` — one module-jitted per-chunk
+    program accumulating J^T D J v partials, fleet psum-reduced per
+    chunk, accounted under ``solver.hvp_sweeps``.
+
+    ``hessian_diag`` (optional, ``w → diag H(w)``) enables Jacobi
+    preconditioning: one aux pass at the warm start buys the diagonal,
+    CG then runs in the scaled space p̂ = D^{1/2} p with the trust
+    region measuring ‖p̂‖ (the LIBLINEAR 2.20 convention) — this
+    collapses the CG iteration count on badly feature-scaled problems,
+    exactly the ill-conditioned regime TRON exists for.  The
+    preconditioner is FROZEN for the whole solve (any fixed SPD scaling
+    is a valid preconditioner; freshness affects CG speed, never the
+    answer) and rides the snapshot tree so resumes stay bitwise.
+
+    The predicted reduction is recovered incrementally from the CG
+    residual (prered = ½(p̂ᵀr̂ − ĝᵀp̂), with r̂ kept consistent on the
+    boundary exits) — no dedicated H·p̂ pass, so an outer iteration
+    costs exactly ``cg_iters`` HVP passes plus one trial evaluation.
+
+    Mid-CG resume (ISSUE 9 semantics): with solver-iteration
+    checkpointing enabled a snapshot is cut after every CG step — the
+    CG basis vectors (p̂, r̂, d̂, rs), trust radius, and outer (w, f, g)
+    all ride the state tree, fingerprinted like the L-BFGS snapshots —
+    so a SIGKILL inside the inner loop resumes at the exact HVP
+    boundary and reproduces the uninterrupted fit bitwise.
+    """
+    w = jnp.asarray(w0, jnp.float32)
+    solver_name = "streaming_tron"
+
+    ck, ck_label = _solver_checkpoint(solver_name, label)
+    fp = (_solver_fingerprint(config.cg_max_iters, w)
+          if ck is not None else None)
+    restored = ck.load_solver(ck_label) if ck is not None else None
+    if restored is not None and restored.get("fp") != fp:
+        logger.warning(
+            "streaming tron '%s': solver snapshot ignored — "
+            "objective/warm-start fingerprint mismatch (config changed "
+            "since the interrupted run?)", label)
+        restored = None
+    cg_state = None
+    if restored is not None:
+        # Mid-solve resume: the loop re-enters at the exact snapshot
+        # boundary — outer point, radius, and (mid-CG) the basis
+        # vectors — so the continuation is the run the kill
+        # interrupted.  The initial fused evaluation (and the
+        # preconditioner pass) are NOT repaid and not counted.
+        telemetry.count("solver.resumed_solves")
+        w = jnp.asarray(restored["w"], jnp.float32)
+        f = jnp.asarray(restored["f"], jnp.float32)
+        g = jnp.asarray(restored["g"], jnp.float32)
+        delta = float(restored["delta"])
+        g0_norm = float(restored["g0_norm"])
+        scale = (None if restored.get("scale") is None
+                 else jnp.asarray(restored["scale"], jnp.float32))
+        tracker = _restore_tracker(restored["tracker"])
+        converged = bool(restored["converged"])
+        it = int(restored["it"])
+        steps = int(restored["steps"])
+        cg = restored.get("cg")
+        if cg is not None:
+            cg_state = (jnp.asarray(cg["p"], jnp.float32),
+                        jnp.asarray(cg["r"], jnp.float32),
+                        jnp.asarray(cg["d"], jnp.float32),
+                        jnp.asarray(cg["rs"], jnp.float32),
+                        int(cg["cg_it"]))
+        _restore_fleet_seq(restored.get("fleet_seq"))
+        logger.info(
+            "streaming tron '%s': resumed at iteration %d%s", label, it,
+            f" (mid-CG, step {cg_state[4]})" if cg_state else "")
+    else:
+        # Sweep-odometer accounting (ISSUE 8): the initial fused
+        # evaluation is the one pass the streamed_solves tick claims;
+        # CG passes ride hvp_sweeps, trial evaluations ride ls_trials,
+        # and the preconditioner diagonal rides aux_sweeps — together
+        # they close the identity `telemetry report` reconciles.
+        telemetry.count("solver.streamed_solves")
+        f, g = value_and_grad(w)
+        scale = None
+        if hessian_diag is not None:
+            diag = hessian_diag(w)
+            scale = 1.0 / jnp.sqrt(jnp.maximum(
+                jnp.asarray(diag, jnp.float32), 1e-12))
+        g0_norm = float(jnp.linalg.norm(g))
+        delta = float(jnp.linalg.norm(g if scale is None else scale * g))
+        tracker = StatesTracker.create(config.max_iters)
+        if config.track_states:
+            tracker = tracker.record(jnp.asarray(0, jnp.int32), f,
+                                     jnp.asarray(g0_norm))
+        converged = bool(grad_converged(jnp.asarray(g0_norm),
+                                        jnp.asarray(g0_norm),
+                                        config.tolerance))
+        it = 0
+        steps = 0
+
+    def save(cg):
+        """Cadence-gated snapshot at the current (outer, CG) boundary.
+        ``steps`` counts HVP passes + outer commits, so the configured
+        ``every_solver_iters`` cadence lands INSIDE long CG solves."""
+        if ck is None:
+            return
+        ck.maybe_save_solver(ck_label, steps, {
+            "fp": fp, "w": w, "f": f, "g": g,
+            "delta": float(delta), "g0_norm": float(g0_norm),
+            "scale": scale, "it": it, "steps": steps,
+            "converged": bool(converged),
+            "tracker": _tracker_state(tracker),
+            "fleet_seq": _fleet_seq(),
+            "cg": cg,
+        })
+
+    while not converged and it < config.max_iters:
+        g_hat = g if scale is None else scale * g
+        tol_cg = config.cg_tolerance * float(jnp.linalg.norm(g_hat))
+        if cg_state is not None:
+            p, r, d, rs, cg_it = cg_state
+            cg_state = None
+        else:
+            p = jnp.zeros_like(g_hat)
+            r = -g_hat
+            d = r
+            rs = jnp.vdot(r, r)
+            cg_it = 0
+        # -- Steihaug-CG inner loop: one chunked HVP pass per step ----
+        while (cg_it < config.cg_max_iters
+               and float(jnp.sqrt(rs)) > tol_cg):
+            hd = (hvp(w, d) if scale is None
+                  else scale * hvp(w, scale * d))
+            dhd = jnp.vdot(d, hd)
+            cg_it += 1
+            steps += 1
+            if float(dhd) <= 0.0:
+                # Negative/zero curvature: march to the boundary, and
+                # keep the residual consistent (r̂ ← r̂ − τ·Ĥd̂) so the
+                # incremental predicted-reduction identity below stays
+                # exact without a dedicated H·p̂ pass.
+                tau = _boundary_tau(p, d, delta)
+                p = p + tau * d
+                r = r - tau * hd
+                break
+            alpha = rs / jnp.maximum(dhd, 1e-30)
+            p_try = p + alpha * d
+            if float(jnp.linalg.norm(p_try)) >= delta:
+                tau = _boundary_tau(p, d, delta)
+                p = p + tau * d
+                r = r - tau * hd
+                break
+            p = p_try
+            r = r - alpha * hd
+            rs_new = jnp.vdot(r, r)
+            beta = rs_new / jnp.maximum(rs, 1e-30)
+            d = r + beta * d
+            rs = rs_new
+            save({"p": p, "r": r, "d": d, "rs": rs, "cg_it": cg_it})
+
+        predicted = float(0.5 * (jnp.vdot(p, r) - jnp.vdot(g_hat, p)))
+        step = p if scale is None else scale * p
+        w_try = w + step
+        # Trial-point evaluation: accounted like a line-search trial
+        # (accept/reject against the model's predicted reduction).
+        telemetry.count("solver.ls_trials")
+        f_new, g_new = value_and_grad(w_try)
+        f_prev = f
+        actual = float(f) - float(f_new)
+        rho = actual / max(predicted, 1e-30)
+        accept = (rho > _ETA0) and (actual > 0.0)
+        p_norm = float(jnp.linalg.norm(p))   # trust-region (scaled) norm
+        # Radius update (Lin & Moré simplified schedule, as resident):
+        if rho < _SIGMA1:
+            delta = min(delta, p_norm) * _SIGMA1
+        elif rho > 0.75:
+            delta = max(delta, _SIGMA3 * p_norm / 2.0)
+        delta = max(delta, _DELTA_MIN)
+
+        if accept:
+            w, f, g = w_try, f_new, g_new
+        g_norm = float(jnp.linalg.norm(g))
+        conv = bool(grad_converged(jnp.asarray(g_norm),
+                                   jnp.asarray(g0_norm),
+                                   config.tolerance))
+        if accept and bool(loss_converged(f_new, f_prev,
+                                          config.rel_tolerance)):
+            conv = True
+        # Numerical-precision stop (mirrors the resident solver): when
+        # the model predicts less reduction than f32 can measure on
+        # |f|, further iterations only reject steps and shrink Δ.
+        if predicted <= 1e-6 * max(abs(float(f_prev)), 1.0):
+            conv = True
+        stalled = delta <= _DELTA_MIN
+        it += 1
+        steps += 1
+        telemetry.count("solver.iterations")
+        if config.track_states:
+            tracker = tracker.record(
+                jnp.asarray(it, jnp.int32), f, jnp.asarray(g_norm),
+                step_size=jnp.asarray(p_norm if accept else 0.0),
+                ls_trials=jnp.asarray(float(cg_it)))
+        _conv.iteration(solver_name, label, it, float(f), g_norm,
+                        step_size=(p_norm if accept else 0.0),
+                        ls_trials=cg_it, delta=delta, rho=rho)
+        # Live solver progress (ISSUE 10): the `train.tron` monitor
+        # stage — iteration count against the budget plus the loss the
+        # online divergence rules watch.
+        _mon.progress("train.tron" + (f".{label}" if label else ""),
+                      it, config.max_iters, unit="iters",
+                      loss=float(f), grad_norm=g_norm)
+        logger.info(
+            "streaming tron iter %d: f=%.6f |g|=%.3e delta=%.3e "
+            "rho=%.3f cg=%d%s", it, float(f), g_norm, delta, rho,
+            cg_it, "" if accept else " (rejected)")
+        converged = conv
+        save(None)
+        if stalled:
+            break
+
+    if ck is not None:
+        ck.clear_solver(ck_label)   # superseded by the result
+    result = OptimizationResult(
+        w=w,
+        value=f,
+        grad_norm=jnp.linalg.norm(g),
         iterations=jnp.asarray(it, jnp.int32),
         converged=jnp.asarray(converged),
         tracker=tracker,

@@ -45,12 +45,31 @@ _DELTA_MIN = 1e-12
 
 
 def _boundary_tau(p: Array, d: Array, delta: Array) -> Array:
-    """τ ≥ 0 with ‖p + τ·d‖ = Δ (largest root of the quadratic)."""
-    dd = jnp.vdot(d, d)
+    """τ ≥ 0 with ‖p + τ·d‖ = Δ (largest root of the quadratic).
+
+    Numerically hardened for f32 (ISSUE 17): when p already sits on the
+    boundary to rounding (‖p‖² ⩾ Δ² by an ulp, which CG's accumulated
+    float32 updates produce), ``Δ² − pp`` goes negative-by-epsilon and
+    the classic ``(disc − pd)/dd`` numerator cancels catastrophically
+    for pd > 0 — the clamped discriminant then yields a small NEGATIVE
+    τ, a backward step that exits CG inside the region while reporting
+    a boundary hit (and an unguarded discriminant would be NaN, which
+    poisons the whole CG carry).  Pick the cancellation-free root form
+    per sign(pd) and clamp τ at 0.
+    """
+    dd = jnp.maximum(jnp.vdot(d, d), 1e-30)
     pd = jnp.vdot(p, d)
     pp = jnp.vdot(p, p)
-    disc = jnp.sqrt(jnp.maximum(pd * pd + dd * (delta * delta - pp), 0.0))
-    return (disc - pd) / jnp.maximum(dd, 1e-30)
+    gap = delta * delta - pp
+    disc = jnp.sqrt(jnp.maximum(pd * pd + dd * gap, 0.0))
+    # Largest root of dd·τ² + 2·pd·τ − gap = 0.  The (disc − pd) form
+    # subtracts near-equal magnitudes when pd > 0; its conjugate
+    # gap/(pd + disc) is exact there and degrades gracefully (τ → 0)
+    # when gap underflows negative.
+    tau = jnp.where(pd > 0.0,
+                    gap / jnp.maximum(pd + disc, 1e-30),
+                    (disc - pd) / dd)
+    return jnp.maximum(tau, 0.0)
 
 
 def _steihaug_cg(

@@ -48,12 +48,16 @@ def _round_list(arr, ndigits: int = 8) -> list:
 
 def iteration(solver: str, label: str, it: int, value, grad_norm,
               step_size=None, ls_trials=None, lanes_active=None,
-              lanes_done=None) -> None:
-    """One host-driven solver iteration (streaming L-BFGS/OWL-QN).
+              lanes_done=None, delta=None, rho=None) -> None:
+    """One host-driven solver iteration (streaming L-BFGS/OWL-QN/TRON).
 
     ``value``/``grad_norm`` may be scalars or per-lane arrays (swept
     solves); lane vectors are emitted in full — the grid is small by
-    construction (a handful of λ points)."""
+    construction (a handful of λ points).  ``delta``/``rho`` are the
+    trust-region radius and actual/predicted reduction ratio (ISSUE 17:
+    the TRON radius trajectory is the convergence evidence the step
+    norm alone cannot show — a collapsing δ means rejected steps even
+    when the loss plane looks flat)."""
     t = telemetry.active()
     if t is None:
         return
@@ -75,6 +79,11 @@ def iteration(solver: str, label: str, it: int, value, grad_norm,
         fields["lanes_active"] = int(lanes_active)
     if lanes_done is not None:
         fields["lanes_done"] = int(lanes_done)
+    if delta is not None:
+        fields["delta"] = float(delta)
+    if rho is not None:
+        r = float(rho)
+        fields["rho"] = None if r != r else round(r, 6)
     t._log.event("convergence_iter", **fields)
 
 

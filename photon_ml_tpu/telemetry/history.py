@@ -92,6 +92,14 @@ METRICS: tuple[tuple[str, str, str], ...] = (
     ("mesh_stream", "mesh_stream.barrier_wait_fraction", "lower"),
     ("mesh_stream", "mesh_stream.max_host_peak_rss_mb", "lower"),
     ("mesh_stream", "mesh_stream.passes_per_cycle", "lower"),
+    # Streaming TRON (ISSUE 17): the second-order claim — total data
+    # passes to tolerance creeping up (the pass advantage over
+    # streaming L-BFGS eroding), streamed throughput dropping, or the
+    # TRON arm's peak RSS growing (the HVP pass must stay as
+    # store-bounded as the L-BFGS passes) all gate.
+    ("tron", "tron.passes_to_tol", "lower"),
+    ("tron", "tron.rows_per_sec", "higher"),
+    ("tron", "tron.peak_rss_mb", "lower"),
 )
 
 

@@ -21,9 +21,9 @@ and device accounting, and the reconciliation checks over a
   solved/retired dynamics, and the SWEEP-ODOMETER RECONCILIATION —
   every streamed data pass must be claimed by exactly one accounting
   bucket (``solver.sweeps == streamed_solves + ls_trials +
-  grad_recovery_sweeps + aux_sweeps``), so solver iteration counts and
-  data passes cannot drift apart unnoticed.  A violated identity fails
-  the report (rc 1).
+  grad_recovery_sweeps + aux_sweeps + hvp_sweeps``), so solver
+  iteration counts and data passes cannot drift apart unnoticed.  A
+  violated identity fails the report (rc 1).
 - **Device** (ISSUE 8): per-program FLOPs / bytes accessed from the
   captured XLA cost analyses, the analytic roofline estimate, and the
   measured per-dispatch span time it implies a fraction of — PERF.md's
@@ -83,10 +83,11 @@ def _convergence(events: list[dict], counters: dict) -> dict | None:
 
     Every chunk sweep (``solver.sweeps``) is claimed by an accounting
     bucket: the per-solve initial evaluation
-    (``solver.streamed_solves``), a line-search trial
+    (``solver.streamed_solves``), a line-search/trial-point evaluation
     (``solver.ls_trials``), a gradient-recovery pass
-    (``solver.grad_recovery_sweeps``), or an auxiliary pass
-    (``solver.aux_sweeps`` — Hessian diagonals/HVPs).  The check FAILS
+    (``solver.grad_recovery_sweeps``), an auxiliary pass
+    (``solver.aux_sweeps`` — Hessian diagonals, variance passes), or a
+    TRON CG Hessian-vector pass (``solver.hvp_sweeps``).  The check FAILS
     when the claimed evaluations exceed the data passes (negative
     ``unattributed`` — a solver claiming passes it never streamed is
     impossible accounting, i.e. drift) or, with streamed solves
@@ -101,6 +102,7 @@ def _convergence(events: list[dict], counters: dict) -> dict | None:
     Returns None when the log carries no convergence signal at all
     (pre-ISSUE-8 logs, telemetry off)."""
     iters_by_solver: dict = {}
+    trust_region: dict = {}
     traces = 0
     re_by_coord: dict = {}
     for ev in events:
@@ -108,6 +110,17 @@ def _convergence(events: list[dict], counters: dict) -> dict | None:
         if kind == "convergence_iter":
             key = (ev.get("solver", "?"), ev.get("label", ""))
             iters_by_solver[key] = iters_by_solver.get(key, 0) + 1
+            if ev.get("delta") is not None:
+                # TRON radius/ratio trajectory (ISSUE 17): a collapsing
+                # δ means rejected steps even when the loss plane looks
+                # flat — surfaced per solver in the Convergence section.
+                tr = trust_region.setdefault(
+                    key, {"delta": [], "rho": [], "rejected": 0})
+                tr["delta"].append(float(ev["delta"]))
+                if ev.get("rho") is not None:
+                    tr["rho"].append(float(ev["rho"]))
+                if not ev.get("step_size"):
+                    tr["rejected"] += 1
         elif kind == "convergence_trace":
             traces += 1
         elif kind == "re_convergence":
@@ -129,14 +142,21 @@ def _convergence(events: list[dict], counters: dict) -> dict | None:
                                ev.get("entities_retired_total") or 0)
     sweeps = counters.get("solver.sweeps")
     solves = counters.get("solver.streamed_solves", 0)
+    resumed = counters.get("solver.resumed_solves", 0)
     ls = counters.get("solver.ls_trials", 0)
     grad_rec = counters.get("solver.grad_recovery_sweeps", 0)
     aux = counters.get("solver.aux_sweeps", 0)
     fused = counters.get("solver.fused_cycle_sweeps", 0)
+    hvp = counters.get("solver.hvp_sweeps", 0)
     if (not iters_by_solver and not traces and not re_by_coord
             and sweeps is None):
         return None
-    expected = solves + ls + grad_rec + aux + fused
+    # ISSUE 17: TRON's CG inner-loop passes claim their own bucket
+    # (`solver.hvp_sweeps`); resumed solves claim ZERO passes (the
+    # initial evaluation was streamed — and counted — by the
+    # interrupted predecessor segment), but they still run iterations,
+    # so the iteration/counter cross-check must engage for them too.
+    expected = solves + ls + grad_rec + aux + fused + hvp
     unattributed = (sweeps or 0) - expected
     # Data passes per CD cycle (ISSUE 11): the fused super-sweep's
     # deliverable is this ratio dropping from ~C (coordinates × solver
@@ -147,22 +167,32 @@ def _convergence(events: list[dict], counters: dict) -> dict | None:
                         else None)
     iter_events = sum(iters_by_solver.values())
     ok = unattributed >= 0
-    if solves:
+    if solves or resumed:
         # The live per-iteration events and the counter must agree —
         # an instrumented solver that iterates without emitting (or
-        # vice versa) is wiring drift.
+        # vice versa) is wiring drift.  Resume-only segments (mid-CG
+        # resume: zero fresh solves) are checked too.
         ok = ok and iter_events == counters.get("solver.iterations", 0)
+    # Data passes per (fresh) solve: the TRON-vs-L-BFGS comparison's
+    # headline ratio — how many streamed passes one fit cost.
+    passes_per_solve = (round((sweeps or 0) / solves, 3) if solves
+                        else None)
     return {
         "ok": ok,
         "sweeps": sweeps or 0,
         "streamed_solves": solves,
+        "resumed_solves": resumed,
         "ls_trials": ls,
         "grad_recovery_sweeps": grad_rec,
         "aux_sweeps": aux,
         "fused_cycle_sweeps": fused,
+        "hvp_sweeps": hvp,
         "unattributed_sweeps": unattributed,
         "cd_cycles": cycles,
         "passes_per_cycle": passes_per_cycle,
+        "passes_per_solve": passes_per_solve,
+        "trust_region": {f"{s}:{lbl}" if lbl else s: d
+                         for (s, lbl), d in sorted(trust_region.items())},
         "iterations": {f"{s}:{lbl}" if lbl else s: n
                        for (s, lbl), n in sorted(iters_by_solver.items())},
         "iteration_events": iter_events,
@@ -367,18 +397,34 @@ def report(path: str, threshold: float = 0.9, out=None) -> dict:
             solved = [s for s in d["solved"] if s is not None]
             w(f"  re '{coord}': {d['sweeps']} sweeps, solved/sweep "
               f"{solved}, retired {d['retired']}, woken {d['woken']}")
+        for key, d in conv["trust_region"].items():
+            deltas, rhos = d["delta"], d["rho"]
+            line = (f"  {key} trust region: δ {deltas[0]:.3g} -> "
+                    f"{deltas[-1]:.3g} over {len(deltas)} iters")
+            if rhos:
+                line += (f", ρ in [{min(rhos):.3g}, {max(rhos):.3g}]"
+                         f", {d['rejected']} rejected")
+            w(line)
         w(f"  sweep odometer: {conv['sweeps']} data passes = "
           f"{conv['streamed_solves']} solve inits + "
           f"{conv['ls_trials']} ls trials + "
           f"{conv['grad_recovery_sweeps']} grad recoveries + "
           f"{conv['aux_sweeps']} aux + "
+          f"{conv['hvp_sweeps']} hvp + "
           f"{conv['fused_cycle_sweeps']} fused cycles + "
           f"{conv['unattributed_sweeps']} unattributed "
           f"-> {'PASS' if conv['ok'] else 'FAIL'}")
+        if conv["resumed_solves"]:
+            w(f"  resumed solves: {conv['resumed_solves']} (zero-pass "
+              "inits — streamed by the interrupted segment)")
         if conv["passes_per_cycle"] is not None:
             w(f"  passes/cycle: {conv['passes_per_cycle']} "
               f"({conv['sweeps']} passes / {conv['cd_cycles']} CD "
               "cycles)")
+        if conv["passes_per_solve"] is not None:
+            w(f"  passes/solve: {conv['passes_per_solve']} "
+              f"({conv['sweeps']} passes / {conv['streamed_solves']} "
+              "solves)")
         w()
 
     device = _device(summary)

@@ -934,6 +934,94 @@ def test_collective_in_host_branch_waiver():
 
 
 # ---------------------------------------------------------------------------
+# while-loop-carry-dtype
+# ---------------------------------------------------------------------------
+
+
+def test_while_carry_dtype_flags_float_literal_into_int_carry():
+    vs = check_source(_src("""
+        import jax
+        import jax.numpy as jnp
+
+        def count(x):
+            def body(carry):
+                it, v = carry
+                return it + 1.0, v * 0.5
+            return jax.lax.while_loop(lambda c: c[0] < 8, body,
+                                      (0, x))
+    """))
+    assert _rules(vs) == ["while-loop-carry-dtype"]
+    assert vs[0].line == 7
+    assert "int carry 'it'" in vs[0].message
+
+
+def test_while_carry_dtype_flags_bool_and_f64_folds():
+    vs = check_source(_src("""
+        from jax import lax
+        import numpy as np
+
+        def run(x):
+            def body(carry):
+                done, acc = carry
+                done = done + 1
+                acc = acc * np.float64(0.5)
+                return done, acc
+            return lax.while_loop(lambda c: ~c[0], body,
+                                  (False, lax.full((3,), 0.0)))
+    """))
+    assert sorted(_rules(vs)) == ["while-loop-carry-dtype",
+                                  "while-loop-carry-dtype"]
+    assert "bool carry 'done'" in vs[0].message
+    assert "float64 cast" in vs[1].message
+
+
+def test_while_carry_dtype_flags_single_leaf_lambda_body():
+    vs = check_source(_src("""
+        import jax
+
+        def spin(n):
+            return jax.lax.while_loop(lambda it: it < n,
+                                      lambda it: it + 0.5, 0)
+    """))
+    assert _rules(vs) == ["while-loop-carry-dtype"]
+
+
+def test_while_carry_dtype_clean_cases():
+    vs = check_source(_src("""
+        import jax
+        import jax.numpy as jnp
+
+        def clean(x, w0):
+            def body(carry):
+                it, v = carry
+                # int literal into int carry keeps the dtype.
+                return it + 1, v * 0.5
+            out = jax.lax.while_loop(lambda c: c[0] < 8, body,
+                                     (0, x))
+
+            def body2(carry):
+                a, b = carry
+                return a + 1.0, b * 2.0
+            # Name init: dtype not statically inferable, never flagged.
+            return jax.lax.while_loop(lambda c: c[0] < 9.0, body2,
+                                      (w0, out[1]))
+    """))
+    assert vs == []
+
+
+def test_while_carry_dtype_waiver():
+    vs = check_source(_src("""
+        import jax
+
+        def spin(n):
+            return jax.lax.while_loop(
+                lambda it: it < n,
+                lambda it: it + 1.0, 0)  # photon-lint: disable=while-loop-carry-dtype (carry is rebound to int inside the cond wrapper)
+    """))
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
 # the acceptance corpus + whole-repo gate + CLI contract
 # ---------------------------------------------------------------------------
 
@@ -1000,6 +1088,13 @@ _CORPUS = """
             os.remove(path)
         except OSError:
             pass
+
+
+    def counter_loop(x):
+        def body(carry):
+            it, v = carry
+            return it + 1.0, v * 0.5
+        return jax.lax.while_loop(lambda c: c[0] < 8, body, (0, x))
 """
 
 
@@ -1010,8 +1105,9 @@ def test_fixture_corpus_detects_five_distinct_rules():
     distinct = set(_rules(vs))
     assert {"jit-in-function", "tracer-hygiene", "unlocked-shared-write",
             "accumulator-dtype", "env-read", "naked-clock",
-            "swallowed-exception", "eternal-wait"} <= distinct
-    assert len(distinct) >= 8
+            "swallowed-exception", "eternal-wait",
+            "while-loop-carry-dtype"} <= distinct
+    assert len(distinct) >= 9
 
 
 def test_repo_clean():

@@ -391,3 +391,89 @@ def test_chunked_offsets_padding_grid_rule(rng):
     # Under-long still fails loudly downstream (set_offsets contract).
     with pytest.raises(ValueError):
         coord.train(np.zeros(cb.n - 3, np.float32))
+
+
+@pytest.mark.parametrize("precond", [True, False])
+def test_streaming_tron_matches_resident(rng, precond):
+    """ISSUE 17 tentpole: the host-driven streaming TRON (chunk-
+    accumulated HVP passes feeding the Steihaug-CG inner loop) solves
+    the same smooth strongly-convex problem as the resident
+    ``tron_solve`` — same convergence flag, same final value,
+    coefficients within float-accumulation tolerance (the Jacobi-
+    preconditioned iterates take a different path; both land at the
+    unique minimum)."""
+    from photon_ml_tpu.optim.streaming import streaming_tron_solve
+    from photon_ml_tpu.optim.tron import tron_solve
+
+    rows, cols, vals, labels, weights, offsets = _sparse_problem(rng)
+    d = 900
+    obj = _objective()
+    resident = make_sparse_batch(rows, d, labels, weights=weights,
+                                 offsets=offsets)
+    cb = build_chunked_batch(rows, d, labels, weights=weights,
+                             offsets=offsets, n_chunks=4, layout="ell")
+    cobj = ChunkedGLMObjective(obj, cb, max_resident=4)
+    cfg = OptimizerConfig(max_iters=60, tolerance=1e-7)
+    w0 = jnp.zeros((d,), jnp.float32)
+
+    res_r = tron_solve(lambda w: obj.value_and_gradient(w, resident),
+                       lambda w, v: obj.hessian_vector(w, v, resident),
+                       w0, cfg)
+    res_s = streaming_tron_solve(
+        cobj.value_and_gradient, cobj.hvp_pass, w0, cfg,
+        hessian_diag=cobj.hessian_diagonal if precond else None)
+    assert bool(res_r.converged) and bool(res_s.converged)
+    np.testing.assert_allclose(float(res_s.value), float(res_r.value),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(res_s.w), np.asarray(res_r.w),
+                               rtol=1e-2, atol=2e-3)
+    # The tracker planes are populated: slot 0 (initial) + one per
+    # outer iteration, with the step norm and inner-CG count planes.
+    kt = int(res_s.tracker.count)
+    assert kt == int(res_s.iterations) + 1
+    cg = np.asarray(res_s.tracker.ls_trials)[1:kt]
+    assert np.all(cg >= 1)     # every outer iteration paid CG passes
+
+
+def test_chunked_coordinate_tron_routes_and_swept_rejects(rng):
+    """``ChunkedFixedEffectCoordinate`` routes TRON to the streaming
+    TRON solver (ISSUE 17 lifts the previous chunked-path rejection)
+    and matches the resident coordinate's solution; ``train_swept``
+    keeps the documented L-BFGS-lanes-only contract."""
+    from photon_ml_tpu.game.coordinates import (
+        ChunkedFixedEffectCoordinate,
+    )
+    from photon_ml_tpu.optim.base import OptimizerType
+    from photon_ml_tpu.optim.tron import tron_solve
+
+    rows, cols, vals, labels, weights, offsets = _sparse_problem(
+        rng, n=610, d=80, k=4)
+    d = 80
+    obj = _objective()
+    cb = build_chunked_batch(rows, d, labels, weights=weights,
+                             n_chunks=4, layout="ell")
+    cfg = OptimizerConfig(max_iters=60, tolerance=1e-7)
+    coord = ChunkedFixedEffectCoordinate(
+        name="f", chunked=cb, objective=obj,
+        optimizer=OptimizerType.TRON, config=cfg)
+    w, res = coord.train(np.zeros(cb.n, np.float32))
+    assert bool(res.converged)
+
+    resident = make_sparse_batch(rows, d, labels, weights=weights)
+    ref = tron_solve(
+        lambda v: obj.value_and_gradient(v, resident),
+        lambda v, u: obj.hessian_vector(v, u, resident),
+        jnp.zeros((d,), jnp.float32), cfg)
+    np.testing.assert_allclose(np.asarray(w), np.asarray(ref.w),
+                               rtol=1e-2, atol=2e-3)
+
+    from photon_ml_tpu.ops.regularization import (
+        RegularizationType,
+        SweptRegularization,
+    )
+
+    with pytest.raises(ValueError, match="LBFGS"):
+        coord.train_swept(
+            np.zeros(cb.n, np.float32),
+            SweptRegularization.from_grid(RegularizationType.L2,
+                                          [0.1, 1.0]))

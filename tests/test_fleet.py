@@ -379,3 +379,117 @@ def test_fleet_kill_one_host_resumes_bitwise(tmp_path):
         np.testing.assert_array_equal(np.asarray(ref[0]["re0"]),
                                       np.asarray(r["re0"]))
     assert r0["seq"] == ref[0]["seq"]
+
+
+# ---------------------------------------------------------------------------
+# Streamed TRON across the fleet (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+_TRON_WORKER = r'''
+import json
+import os
+import sys
+
+sys.path.insert(0, os.environ["PML_REPO"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main():
+    from photon_ml_tpu.parallel import fleet
+
+    fleet.initialize_from_env()
+
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.chunked_batch import build_chunked_batch
+    from photon_ml_tpu.data.normalization import NormalizationContext
+    from photon_ml_tpu.data.sparse_rows import SparseRows
+    from photon_ml_tpu.ops import losses
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.ops.regularization import RegularizationContext
+    from photon_ml_tpu.optim.base import OptimizerConfig
+    from photon_ml_tpu.optim.streaming import (
+        ChunkedGLMObjective,
+        streaming_tron_solve,
+    )
+
+    # Every host builds the SAME dataset (seeded); build_chunked_batch
+    # shards the chunk schedule by the fleet context, and the per-chunk
+    # psum inside value/gradient/HVP passes re-totals the statistics.
+    n, d, k = 640, 48, 4
+    rng = np.random.default_rng(17)
+    cols = np.stack([np.sort(rng.choice(d, k, replace=False))
+                     for _ in range(n)]).astype(np.int64)
+    vals = rng.normal(0, 1, (n, k)).astype(np.float32)
+    vals = vals * np.power(
+        10.0, -1.5 * cols / max(d - 1, 1)).astype(np.float32)
+    w_true = rng.normal(0, 1, d).astype(np.float32)
+    m = np.einsum("nk,nk->n", vals, w_true[cols])
+    y = (m + rng.normal(0, 0.3, n) > 0).astype(np.float32)
+    rows = SparseRows.from_flat(np.arange(n + 1, dtype=np.int64) * k,
+                                cols.reshape(-1), vals.reshape(-1))
+    obj = GLMObjective(loss=losses.LOGISTIC,
+                       reg=RegularizationContext.l2(0.1),
+                       norm=NormalizationContext.identity())
+    cb = build_chunked_batch(rows, d, y, n_chunks=4, layout="ell")
+    cobj = ChunkedGLMObjective(obj, cb, max_resident=4)
+    res = streaming_tron_solve(
+        cobj.value_and_gradient, cobj.hvp_pass,
+        jnp.zeros(d, jnp.float32),
+        OptimizerConfig(max_iters=40, tolerance=1e-8),
+        hessian_diag=cobj.hessian_diagonal)
+    red = fleet.reducer()
+    ctx = fleet.active()
+    print("RESULT " + json.dumps({
+        "w": np.asarray(res.w).tolist(),
+        "iterations": int(res.iterations),
+        "converged": bool(res.converged),
+        "seq": red.seq if red is not None else -1,
+        "host": ctx.host_id if ctx is not None else -1,
+    }))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
+'''
+
+
+@pytest.mark.slow   # 3 subprocess streamed TRON fits
+def test_fleet_streaming_tron_bitwise_across_hosts_and_matches_solo(
+        tmp_path):
+    """2 tcp-fleet hosts run the streamed TRON fit over sharded chunks
+    (value/gradient, Hessian-diag, and every CG HVP pass psum-reduced
+    per chunk); both hosts end with BITWISE-identical coefficients at
+    the same iteration count and reduce sequence, and the fit matches
+    a solo run of the same workload to float tolerance (chunk-shard
+    summation order differs, so bitwise is only expected ACROSS fleet
+    hosts)."""
+    script = tmp_path / "tron_worker.py"
+    script.write_text(_TRON_WORKER)
+    n_hosts = 2
+    coord = fleet.ReduceCoordinator(n_hosts)
+    try:
+        procs = [_spawn_worker(str(script), _fleet_env(
+            coord, h, n_hosts, str(tmp_path / "fleet")))
+            for h in range(n_hosts)]
+        results = [_result(p, f"host{h}")
+                   for h, p in enumerate(procs)]
+    finally:
+        coord.close()
+    solo = _result(_spawn_worker(str(script), {}), "solo")
+
+    w = [np.asarray(r["w"], np.float32) for r in results]
+    np.testing.assert_array_equal(w[0], w[1])
+    assert results[0]["iterations"] == results[1]["iterations"]
+    assert results[0]["converged"] is True
+    assert solo["converged"] is True
+    # Same reduce count on every host == the barrier never skewed, and
+    # the HVP passes actually went through the fleet reducer.
+    assert len({r["seq"] for r in results}) == 1
+    assert results[0]["seq"] > 0
+    assert solo["seq"] == -1
+    np.testing.assert_allclose(w[0], np.asarray(solo["w"], np.float32),
+                               rtol=1e-3, atol=1e-3)

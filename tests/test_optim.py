@@ -352,3 +352,43 @@ def test_weighted_examples_shift_solution(rng):
     r2 = lbfgs_solve(lambda w: obj.value_and_gradient(w, batch_rep),
                      jnp.zeros(d, jnp.float32), CFG)
     np.testing.assert_allclose(r1.w, r2.w, atol=1e-3)
+
+
+def test_boundary_tau_nonnegative_at_f32_boundary_crossing():
+    """ISSUE 17 hardening: when ‖p‖ crosses Δ by one f32 rounding step
+    (gap = Δ² − ‖p‖² negative by an ulp) while p·d > 0, the textbook
+    root (−p·d + √disc)/(d·d) cancels catastrophically and returns a
+    small NEGATIVE τ — a backward step that "exits" the trust region
+    from inside while the CG loop reports a boundary hit.  The
+    conjugate-root form plus the final clamp must return τ ≥ 0 with no
+    NaN."""
+    from photon_ml_tpu.optim.tron import _boundary_tau
+
+    delta = jnp.float32(1.0)
+    p = jnp.asarray([1.0 + 1.2e-7, 0.0], jnp.float32)  # ‖p‖ > Δ by ~1 ulp
+    d = jnp.asarray([1.0, 1e-4], jnp.float32)          # p·d > 0
+    tau = float(_boundary_tau(p, d, delta))
+    assert np.isfinite(tau)
+    assert tau >= 0.0
+    assert tau < 1e-6   # the true root is within rounding of zero
+
+
+def test_boundary_tau_roots_and_degenerate_direction():
+    """Both quadratic branches return the exact boundary crossing, and
+    a zero direction (the d·d floor) stays finite and non-negative."""
+    from photon_ml_tpu.optim.tron import _boundary_tau
+
+    delta = jnp.float32(1.0)
+    # Forward crossing from inside (p·d > 0): 0.5 + τ = 1 → τ = 0.5.
+    tau = float(_boundary_tau(jnp.asarray([0.5, 0.0], jnp.float32),
+                              jnp.asarray([1.0, 0.0], jnp.float32),
+                              delta))
+    np.testing.assert_allclose(tau, 0.5, rtol=1e-6)
+    # Backward direction (p·d < 0): 0.5 − τ = −1 → τ = 1.5.
+    tau = float(_boundary_tau(jnp.asarray([0.5, 0.0], jnp.float32),
+                              jnp.asarray([-1.0, 0.0], jnp.float32),
+                              delta))
+    np.testing.assert_allclose(tau, 1.5, rtol=1e-6)
+    tau = float(_boundary_tau(jnp.asarray([0.5, 0.0], jnp.float32),
+                              jnp.zeros(2, jnp.float32), delta))
+    assert np.isfinite(tau) and tau >= 0.0

@@ -52,6 +52,7 @@ from photon_ml_tpu.optim.streaming import (
     ChunkedGLMObjective,
     streaming_lbfgs_solve,
     streaming_lbfgs_solve_swept,
+    streaming_tron_solve,
 )
 from photon_ml_tpu.reliability import checkpoint as ckpt
 from photon_ml_tpu.reliability import faults
@@ -281,6 +282,65 @@ def test_resumed_solver_odometer_counts_resume_not_solve(rng, tmp_path):
         c = _counters(t)
     assert c.get("solver.resumed_solves") == 1
     assert "solver.streamed_solves" not in c
+
+
+def _quadratic_newton(rng, n=300, d=10):
+    """Least-squares quadratic with exact HVP / Hessian diagonal for
+    the TRON resume tests; per-column scales make CG take several
+    steps per outer iteration, so a small ``fail_after`` lands the
+    interrupt INSIDE the inner loop."""
+    X = (rng.normal(size=(n, d)).astype(np.float32)
+         * np.logspace(0, -2, d).astype(np.float32))
+    y = (X @ rng.normal(size=d) + 0.1 * rng.normal(size=n)).astype(
+        np.float32)
+
+    def vg(w):
+        w = jnp.asarray(w, jnp.float32)
+        r = X @ w - y
+        return 0.5 * jnp.mean(r * r), X.T @ r / n
+
+    def hvp(w, v):
+        return X.T @ (X @ jnp.asarray(v, jnp.float32)) / n
+
+    def diag(w):
+        return jnp.asarray((X * X).mean(axis=0))
+
+    return d, vg, hvp, diag
+
+
+def test_streaming_tron_mid_cg_resume_is_bitwise(rng, tmp_path, caplog):
+    """Kill inside Steihaug-CG (the HVP callable raises mid-inner-loop,
+    the stand-in for a SIGKILL between chunk passes); the resume
+    re-enters at the exact HVP boundary — outer point, radius, frozen
+    preconditioner, AND the CG basis vectors — and reproduces the
+    uninterrupted fit bitwise.  The resumed solve's odometer counts the
+    resume, not a fresh solve: neither the initial fused evaluation nor
+    the preconditioner pass is repaid (ISSUE 17)."""
+    d, vg, hvp, diag = _quadratic_newton(rng)
+    cfg = OptimizerConfig(max_iters=40, tolerance=1e-9)
+    ref = streaming_tron_solve(vg, hvp, jnp.zeros(d), cfg,
+                               hessian_diag=diag, label="q")
+    ck = RunCheckpointer(str(tmp_path), every_solver_iters=1,
+                         resume=True)
+    caplog.set_level("INFO", logger="photon_ml_tpu.optim.streaming")
+    with ckpt.session(ck), ck.scope("it1", "q"):
+        with pytest.raises(_Interrupt):
+            streaming_tron_solve(vg, _flaky(hvp, 3), jnp.zeros(d), cfg,
+                                 hessian_diag=diag, label="q")
+        assert glob.glob(str(tmp_path / "solver_*.npz"))
+        with metrics_session() as t:
+            res = streaming_tron_solve(vg, hvp, jnp.zeros(d), cfg,
+                                       hessian_diag=diag, label="q")
+        c = _counters(t)
+    # The interrupt landed mid-CG and the resume says so.
+    assert "mid-CG" in caplog.text
+    np.testing.assert_array_equal(np.asarray(res.w), np.asarray(ref.w))
+    assert int(res.iterations) == int(ref.iterations)
+    assert c.get("solver.resumed_solves") == 1
+    assert "solver.streamed_solves" not in c
+    assert "solver.aux_sweeps" not in c      # preconditioner not repaid
+    # The solver state file is cleared once the solve completes.
+    assert glob.glob(str(tmp_path / "solver_*.npz")) == []
 
 
 # ---------------------------------------------------------------------------

@@ -784,6 +784,61 @@ def test_convergence_events_reconcile_with_odometer(tmp_path, capsys):
     assert conv["iterations"]["streaming_lbfgs"] == len(iters)
 
 
+def test_tron_convergence_reconciles_with_hvp_odometer(tmp_path, capsys):
+    """ISSUE 17: a streamed TRON fit closes the sweep-odometer identity
+    through the new hvp_sweeps term exactly — sweeps == streamed_solves
+    (the initial value+gradient) + ls_trials (one trial point per outer
+    iteration) + aux_sweeps (the Jacobi diagonal) + hvp_sweeps (the CG
+    passes) — and the report renders the trust-region trajectory (the
+    per-iteration delta/rho the convergence events carry)."""
+    from photon_ml_tpu.optim.streaming import streaming_tron_solve
+    from photon_ml_tpu.telemetry.__main__ import main as telemetry_main
+
+    cobj = _spilled_objective(tmp_path)
+    log_path = str(tmp_path / "run_log.jsonl")
+    log = RunLogger(log_path)
+    t = telemetry.start("metrics", run_logger=log)
+    try:
+        streaming_tron_solve(
+            cobj.value_and_gradient, cobj.hvp_pass,
+            jnp.zeros(D, jnp.float32),
+            OptimizerConfig(max_iters=4, tolerance=1e-9),
+            hessian_diag=cobj.hessian_diagonal, label="t")
+        summary = t.summary()
+    finally:
+        t.close()
+        log.close()
+    c = summary["counters"]
+    assert c["solver.hvp_sweeps"] > 0
+    assert c["solver.aux_sweeps"] >= 1       # the preconditioner pass
+    assert c["solver.sweeps"] == (
+        c["solver.streamed_solves"] + c["solver.ls_trials"]
+        + c.get("solver.grad_recovery_sweeps", 0)
+        + c["solver.aux_sweeps"]
+        + c.get("solver.fused_cycle_sweeps", 0)
+        + c["solver.hvp_sweeps"])
+    events = read_run_log(log_path)
+    iters = [e for e in events if e["event"] == "convergence_iter"]
+    assert len(iters) == c["solver.iterations"]
+    # Every TRON iteration event carries the radius and the ratio.
+    assert all(e.get("delta", 0) > 0 for e in iters)
+    assert all("rho" in e for e in iters)
+    rc = telemetry_main(["report", log_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    tail = json.loads(out.strip().splitlines()[-1])
+    conv = tail["convergence"]
+    assert conv["ok"] is True
+    assert conv["unattributed_sweeps"] == 0
+    assert conv["hvp_sweeps"] == c["solver.hvp_sweeps"]
+    assert conv["passes_per_solve"] == c["solver.sweeps"]
+    tr = conv["trust_region"]["streaming_tron:t"]
+    assert len(tr["delta"]) == len(iters)
+    assert tr["delta"][0] > 0
+    assert "trust region" in out
+    assert "hvp" in out
+
+
 def test_direct_evaluations_stay_informational(tmp_path, capsys):
     """A direct objective evaluation outside any solve (a final-loss
     log line, a notebook probe) is a legitimate pass no solve claims:
